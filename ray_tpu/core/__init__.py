@@ -1,0 +1,11 @@
+"""Core runtime: IDs, config, serialization, tasks, actors, objects."""
+
+from .config import RuntimeConfig, define_flag, flags  # noqa: F401
+from .errors import (ActorDiedError, ActorError, GetTimeoutError,  # noqa: F401
+                     ObjectLostError, OutOfMemoryError, RayTpuError,
+                     TaskCancelledError, TaskError, WorkerCrashedError)
+from .ids import (ActorID, JobID, NodeID, ObjectID,  # noqa: F401
+                  PlacementGroupID, TaskID, WorkerID)
+from .object_ref import ObjectRef  # noqa: F401
+from .resources import ResourceSet, detect_tpu, node_resources  # noqa: F401
+from .task import SchedulingStrategy, TaskKind, TaskSpec  # noqa: F401

@@ -1,0 +1,350 @@
+"""Public task/actor API: ``remote``, ``get``, ``put``, ``wait``, actors.
+
+Role-equivalent to the reference's frontend (ref:
+python/ray/remote_function.py:303 RemoteFunction._remote,
+python/ray/actor.py ActorClass/ActorHandle, python/ray/_private/worker.py
+get/put/wait).  All calls delegate to the active Runtime backend (local or
+cluster); specs are built here so both backends share one code path.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import cloudpickle
+
+from . import runtime as _runtime_mod
+from .ids import ActorID
+from .object_ref import ObjectRef
+from .resources import task_resources
+from .task import (ArgKind, SchedulingStrategy, TaskArg, TaskKind, TaskSpec,
+                   func_id_of)
+
+_DEFAULT_OPTIONS = dict(
+    num_cpus=None,
+    num_tpus=None,
+    memory=None,
+    resources=None,
+    num_returns=1,
+    max_retries=None,
+    retry_exceptions=False,
+    name="",
+    max_restarts=0,
+    max_task_retries=0,
+    max_concurrency=1,
+    lifetime=None,
+    namespace="",
+    scheduling_strategy=None,
+    runtime_env=None,
+    get_if_exists=False,
+)
+
+
+def _merge_options(base: Dict[str, Any], **updates) -> Dict[str, Any]:
+    out = dict(base)
+    for k, v in updates.items():
+        if k not in _DEFAULT_OPTIONS:
+            raise TypeError(f"Unknown option {k!r}")
+        out[k] = v
+    return out
+
+
+def _build_args(args: Tuple, kwargs: Dict[str, Any]) -> Tuple[List[TaskArg], List[str]]:
+    task_args: List[TaskArg] = []
+    for a in args:
+        if isinstance(a, ObjectRef):
+            task_args.append(TaskArg(ArgKind.OBJECT_REF, object_id=a.id))
+        else:
+            task_args.append(TaskArg(ArgKind.VALUE, value=a))
+    kw_keys = []
+    for k, v in kwargs.items():
+        kw_keys.append(k)
+        if isinstance(v, ObjectRef):
+            task_args.append(TaskArg(ArgKind.OBJECT_REF, object_id=v.id))
+        else:
+            task_args.append(TaskArg(ArgKind.VALUE, value=v))
+    return task_args, kw_keys
+
+
+def _strategy(opts: Dict[str, Any]) -> SchedulingStrategy:
+    s = opts.get("scheduling_strategy")
+    if s is None:
+        return SchedulingStrategy()
+    if isinstance(s, SchedulingStrategy):
+        return s
+    if s == "SPREAD":
+        return SchedulingStrategy(kind="SPREAD")
+    if s == "DEFAULT":
+        return SchedulingStrategy()
+    raise ValueError(f"Unknown scheduling strategy {s!r}")
+
+
+class RemoteFunction:
+    """A function decorated with ``@remote``; call via ``.remote(...)``."""
+
+    def __init__(self, func, options: Dict[str, Any]):
+        self._func = func
+        self._options = options
+        self._blob: Optional[bytes] = None
+        self._func_id: Optional[str] = None
+        functools.update_wrapper(self, func)
+
+    def _ensure_blob(self) -> Tuple[str, bytes]:
+        if self._blob is None:
+            self._blob = cloudpickle.dumps(self._func)
+            self._func_id = func_id_of(self._blob)
+        return self._func_id, self._blob
+
+    def options(self, **updates) -> "RemoteFunction":
+        rf = RemoteFunction(self._func, _merge_options(self._options, **updates))
+        rf._blob, rf._func_id = self._blob, self._func_id
+        return rf
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        rt = _runtime_mod.get_runtime()
+        func_id, blob = self._ensure_blob()
+        opts = self._options
+        task_args, kw_keys = _build_args(args, kwargs)
+        cfg = rt.config
+        max_retries = opts["max_retries"]
+        if max_retries is None:
+            max_retries = cfg.max_task_retries
+        spec = TaskSpec(
+            task_id=rt.next_task_id(),
+            job_id=rt.job_id,
+            kind=TaskKind.NORMAL,
+            func_id=func_id,
+            func_blob=blob,
+            args=task_args,
+            kwargs_keys=kw_keys,
+            num_returns=opts["num_returns"],
+            resources=task_resources(
+                opts["num_cpus"], opts["num_tpus"], opts["memory"],
+                opts["resources"]),
+            max_retries=max_retries,
+            retry_exceptions=opts["retry_exceptions"],
+            name=opts["name"] or getattr(self._func, "__name__", ""),
+            scheduling=_strategy(opts),
+            runtime_env=opts["runtime_env"],
+        )
+        refs = rt.submit_task(spec)
+        return refs[0] if spec.num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{getattr(self._func, '__name__', '?')}' cannot "
+            f"be called directly; use .remote()."
+        )
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, **updates) -> "ActorMethod":
+        m = ActorMethod(self._handle, self._name, self._num_returns)
+        if "num_returns" in updates:
+            m._num_returns = updates.pop("num_returns")
+        if updates:
+            raise TypeError(f"Unsupported actor-method options: {list(updates)}")
+        return m
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit_method(self._name, args, kwargs,
+                                           self._num_returns)
+
+
+class ActorHandle:
+    """Client-side handle to a live actor; picklable into tasks."""
+
+    def __init__(self, actor_id: ActorID, class_name: str,
+                 method_names: List[str], namespace: str = ""):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._method_names = list(method_names)
+        self._namespace = namespace
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._method_names:
+            raise AttributeError(
+                f"Actor {self._class_name} has no method {name!r}")
+        return ActorMethod(self, name)
+
+    def _submit_method(self, method: str, args, kwargs, num_returns: int):
+        rt = _runtime_mod.get_runtime()
+        task_args, kw_keys = _build_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=rt.next_actor_task_id(self._actor_id),
+            job_id=rt.job_id,
+            kind=TaskKind.ACTOR_TASK,
+            func_id="",
+            method_name=method,
+            args=task_args,
+            kwargs_keys=kw_keys,
+            num_returns=num_returns,
+            actor_id=self._actor_id,
+            seq_no=rt.next_actor_seq(self._actor_id),
+            name=f"{self._class_name}.{method}",
+        )
+        refs = rt.submit_actor_task(spec)
+        return refs[0] if num_returns == 1 else refs
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name,
+                              self._method_names, self._namespace))
+
+
+class ActorClass:
+    """A class decorated with ``@remote``; instantiate via ``.remote(...)``."""
+
+    def __init__(self, cls, options: Dict[str, Any]):
+        self._cls = cls
+        self._options = options
+        self._blob: Optional[bytes] = None
+        self._func_id: Optional[str] = None
+
+    def options(self, **updates) -> "ActorClass":
+        ac = ActorClass(self._cls, _merge_options(self._options, **updates))
+        ac._blob, ac._func_id = self._blob, self._func_id
+        return ac
+
+    def _ensure_blob(self):
+        if self._blob is None:
+            self._blob = cloudpickle.dumps(self._cls)
+            self._func_id = func_id_of(self._blob)
+        return self._func_id, self._blob
+
+    def _method_names(self) -> List[str]:
+        return [
+            n for n, _ in inspect.getmembers(self._cls, callable)
+            if not n.startswith("__")
+        ]
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        rt = _runtime_mod.get_runtime()
+        opts = self._options
+        name = opts["name"]
+        if name and opts["get_if_exists"]:
+            try:
+                return rt.get_named_actor(name, opts["namespace"])
+            except ValueError:
+                pass
+        func_id, blob = self._ensure_blob()
+        actor_id = rt.next_actor_id()
+        task_args, kw_keys = _build_args(args, kwargs)
+        res = task_resources(
+            opts["num_cpus"], opts["num_tpus"], opts["memory"],
+            opts["resources"], default_cpus=1.0)
+        spec = TaskSpec(
+            task_id=rt.actor_creation_task_id(actor_id),
+            job_id=rt.job_id,
+            kind=TaskKind.ACTOR_CREATION,
+            func_id=func_id,
+            func_blob=blob,
+            args=task_args,
+            kwargs_keys=kw_keys,
+            num_returns=1,
+            resources=res,
+            max_restarts=opts["max_restarts"],
+            max_concurrency=opts["max_concurrency"],
+            actor_id=actor_id,
+            actor_name=name,
+            namespace=opts["namespace"],
+            name=f"{self._cls.__name__}.__init__",
+            scheduling=_strategy(opts),
+            runtime_env=opts["runtime_env"],
+        )
+        rt.create_actor(spec)
+        return ActorHandle(actor_id, self._cls.__name__, self._method_names(),
+                           opts["namespace"])
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"Actor class {self._cls.__name__} cannot be instantiated "
+            f"directly; use .remote()."
+        )
+
+
+def remote(*args, **options):
+    """``@remote`` decorator for functions and classes.
+
+    Usage: ``@remote`` or ``@remote(num_cpus=2, num_tpus=1, ...)``.
+    """
+    if len(args) == 1 and not options and (inspect.isfunction(args[0])
+                                           or inspect.isclass(args[0])):
+        target = args[0]
+        opts = dict(_DEFAULT_OPTIONS)
+        if inspect.isclass(target):
+            return ActorClass(target, opts)
+        return RemoteFunction(target, opts)
+    if args:
+        raise TypeError("remote() takes keyword options only")
+    opts = _merge_options(_DEFAULT_OPTIONS, **options)
+
+    def wrap(target):
+        if inspect.isclass(target):
+            return ActorClass(target, opts)
+        return RemoteFunction(target, opts)
+
+    return wrap
+
+
+# ---------------------------------------------------------------------------
+# Module-level object API.
+# ---------------------------------------------------------------------------
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed")
+    return _runtime_mod.get_runtime().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    rt = _runtime_mod.get_runtime()
+    if isinstance(refs, ObjectRef):
+        return rt.get([refs], timeout)[0]
+    if isinstance(refs, (list, tuple)):
+        bad = [r for r in refs if not isinstance(r, ObjectRef)]
+        if bad:
+            raise TypeError(f"get() expects ObjectRefs, got {type(bad[0])}")
+        return rt.get(list(refs), timeout)
+    raise TypeError(f"get() expects an ObjectRef or a list, got {type(refs)}")
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None,
+         fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if not refs:
+        return [], []
+    if num_returns <= 0 or num_returns > len(refs):
+        raise ValueError(f"num_returns must be in [1, {len(refs)}]")
+    return _runtime_mod.get_runtime().wait(list(refs), num_returns, timeout,
+                                           fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    _runtime_mod.get_runtime().kill_actor(actor.actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    _runtime_mod.get_runtime().cancel(ref, force)
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    return _runtime_mod.get_runtime().get_named_actor(name, namespace)

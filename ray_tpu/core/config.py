@@ -1,0 +1,149 @@
+"""Runtime configuration flag table.
+
+Equivalent in role to the reference's RAY_CONFIG X-macro table (ref:
+src/ray/common/ray_config_def.h), rebuilt as a typed Python registry: every
+flag has a name, type, default, and doc; every flag is overridable via the
+``RT_<NAME>`` environment variable so cluster-wide propagation is just env
+inheritance.  A frozen snapshot is attached to each session and shipped to
+every spawned process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RT_"
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: int,
+    float: float,
+    str: str,
+}
+
+
+@dataclass
+class _Flag:
+    name: str
+    type: type
+    default: Any
+    doc: str
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, type_: type, default: Any, doc: str = "") -> None:
+    _REGISTRY[name] = _Flag(name, type_, default, doc)
+
+
+# ---------------------------------------------------------------------------
+# Core runtime flags (ref counterpart: ray_config_def.h flag table).
+# ---------------------------------------------------------------------------
+define_flag("raylet_heartbeat_period_ms", int, 1000,
+            "Node agent -> controller liveness report period.")
+define_flag("health_check_failure_threshold", int, 5,
+            "Missed heartbeats before a node is marked dead.")
+define_flag("task_retry_delay_ms", int, 100,
+            "Delay before resubmitting a failed retriable task.")
+define_flag("max_task_retries", int, 3,
+            "Default retry budget for retriable normal tasks.")
+define_flag("max_actor_restarts", int, 0,
+            "Default actor restart budget (0 = no restart).")
+define_flag("object_store_memory_bytes", int, 2 * 1024**3,
+            "Per-node shared-memory object store capacity.")
+define_flag("object_inline_max_bytes", int, 100 * 1024,
+            "Objects at or below this size are inlined in control messages "
+            "instead of the shared-memory plane.")
+define_flag("worker_pool_min_workers", int, 0,
+            "Pre-started idle workers per node.")
+define_flag("worker_pool_max_workers", int, 0,
+            "Max concurrent workers per node (0 = #CPUs).")
+define_flag("worker_idle_timeout_s", float, 60.0,
+            "Idle worker reap timeout.")
+define_flag("worker_start_timeout_s", float, 60.0,
+            "Time allowed for a worker process to register before failing.")
+define_flag("scheduler_spread_threshold", float, 0.5,
+            "Hybrid policy: utilization below which tasks pack onto the "
+            "local node before spilling (ref: hybrid_scheduling_policy.h).")
+define_flag("scheduler_top_k_fraction", float, 0.2,
+            "Hybrid policy: random choice among the best k fraction of nodes.")
+define_flag("lineage_max_bytes", int, 64 * 1024**2,
+            "Cap on pinned lineage used for object reconstruction.")
+define_flag("rpc_connect_timeout_s", float, 30.0, "RPC dial timeout.")
+define_flag("rpc_request_timeout_s", float, 0.0,
+            "Default RPC deadline (0 = none).")
+define_flag("log_to_driver", bool, True,
+            "Stream worker stdout/stderr back to the driver.")
+define_flag("session_dir_root", str, "/tmp/ray_tpu",
+            "Root directory for per-session state (sockets, logs, store).")
+define_flag("shm_dir", str, "/dev/shm",
+            "Directory backing the shared-memory object plane.")
+define_flag("metrics_report_period_s", float, 5.0,
+            "Stats export period from workers/agents.")
+define_flag("task_event_buffer_size", int, 10000,
+            "Max buffered per-task lifecycle events before drop-oldest.")
+define_flag("tracing_enabled", bool, False, "Emit task/actor spans.")
+# TPU-specific flags.
+define_flag("tpu_chips_per_host", int, 0,
+            "Override detected TPU chip count (0 = autodetect).")
+define_flag("tpu_visible_chips_env", str, "TPU_VISIBLE_CHIPS",
+            "Env var used to isolate TPU chips per worker, the TPU analogue "
+            "of CUDA_VISIBLE_DEVICES (ref: _private/accelerators/tpu.py).")
+
+
+@dataclass
+class RuntimeConfig:
+    """Immutable-ish snapshot of all flags for one session."""
+
+    values: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_env(cls, overrides: Dict[str, Any] | None = None) -> "RuntimeConfig":
+        values = {}
+        for name, flag in _REGISTRY.items():
+            raw = os.environ.get(_ENV_PREFIX + name.upper())
+            if raw is not None:
+                values[name] = _PARSERS[flag.type](raw)
+            else:
+                values[name] = flag.default
+        if overrides:
+            for k, v in overrides.items():
+                if k not in _REGISTRY:
+                    raise KeyError(f"Unknown config flag: {k}")
+                values[k] = v
+        return cls(values)
+
+    def __getattr__(self, name: str):
+        try:
+            return self.values[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def to_json(self) -> str:
+        return json.dumps(self.values)
+
+    @classmethod
+    def from_json(cls, s: str) -> "RuntimeConfig":
+        return cls(json.loads(s))
+
+    def env_overrides(self) -> Dict[str, str]:
+        """Env vars that reproduce this config in a child process."""
+        out = {}
+        for name, value in self.values.items():
+            default = _REGISTRY[name].default
+            if value != default:
+                out[_ENV_PREFIX + name.upper()] = str(value)
+        return out
+
+
+def flags() -> Dict[str, _Flag]:
+    return dict(_REGISTRY)

@@ -1,0 +1,138 @@
+"""User-visible exception hierarchy.
+
+Role-equivalent to the reference's exception set (ref:
+python/ray/exceptions.py): errors raised inside remote tasks/actors are
+captured, serialized, and re-raised at the ``get()`` site wrapped in a type
+that inherits BOTH from TaskError and the user's original exception class,
+so ``except ValueError`` still works across the process boundary.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+__all__ = [
+    "RayTpuError", "TaskError", "ActorError", "ActorDiedError",
+    "WorkerCrashedError", "ObjectLostError", "OwnerDiedError",
+    "GetTimeoutError", "NodeDiedError", "RuntimeEnvSetupError",
+    "OutOfMemoryError", "PlacementGroupUnschedulableError",
+    "TaskCancelledError",
+]
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A remote task raised an exception; re-raised at the get() site."""
+
+    def __init__(self, cause_repr: str, traceback_str: str = "",
+                 cause: BaseException | None = None):
+        self.cause_repr = cause_repr
+        self.traceback_str = traceback_str
+        self.cause = cause
+        Exception.__init__(self, cause_repr)
+
+    @classmethod
+    def from_exception(cls, exc: BaseException) -> "TaskError":
+        if isinstance(exc, TaskError):
+            return exc
+        tb = "".join(
+            traceback.format_exception(type(exc), exc, exc.__traceback__))
+        return make_task_error(repr(exc), tb, exc, cls)
+
+    def __str__(self):
+        if not self.traceback_str:
+            return self.cause_repr
+        return f"{self.cause_repr}\n\nRemote traceback:\n{self.traceback_str}"
+
+    def __reduce__(self):
+        import cloudpickle
+
+        cause = self.cause
+        if cause is not None:
+            try:
+                cloudpickle.dumps(cause)
+            except Exception:
+                cause = None
+        kind = ActorError if isinstance(self, ActorError) else TaskError
+        return (make_task_error,
+                (self.cause_repr, self.traceback_str, cause, kind))
+
+
+def make_task_error(cause_repr: str, tb: str,
+                    cause: BaseException | None,
+                    kind: type = TaskError) -> TaskError:
+    """Build a TaskError that also subclasses the original exception type,
+    mirroring the reference's RayTaskError.as_instanceof_cause (ref:
+    python/ray/exceptions.py)."""
+    if cause is not None and not isinstance(cause, TaskError):
+        base = type(cause)
+        if issubclass(base, BaseException) and base not in (Exception,):
+            try:
+                dual = type(f"{kind.__name__}({base.__name__})",
+                            (kind, base), {})
+                return dual(cause_repr, tb, cause)
+            except TypeError:
+                pass
+    return kind(cause_repr, tb, cause)
+
+
+class ActorError(TaskError):
+    """An actor task failed or the actor process died."""
+
+
+class ActorDiedError(ActorError):
+    def __init__(self, actor_id_hex: str = "", reason: str = "actor process died"):
+        super().__init__(f"ActorDied({actor_id_hex}): {reason}", "")
+        self.actor_id_hex = actor_id_hex
+        self.reason = reason
+
+    def __reduce__(self):
+        return (ActorDiedError, (self.actor_id_hex, self.reason))
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing a task died unexpectedly."""
+
+
+class ObjectLostError(RayTpuError):
+    """An object's value was lost from every node and could not be
+    reconstructed from lineage."""
+
+    def __init__(self, object_id_hex: str):
+        super().__init__(f"Object {object_id_hex} was lost and is not "
+                         f"reconstructable from lineage.")
+        self.object_id_hex = object_id_hex
+
+    def __reduce__(self):
+        return (type(self), (self.object_id_hex,))
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """get() exceeded its timeout."""
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class OutOfMemoryError(RayTpuError):
+    """Task was killed by the memory monitor under node memory pressure."""
+
+
+class PlacementGroupUnschedulableError(RayTpuError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    pass

@@ -1,0 +1,160 @@
+"""Deterministic binary identifiers for jobs, tasks, actors, objects, and nodes.
+
+Mirrors the derivation scheme of the reference runtime (ref:
+src/ray/common/id.h) without copying its layout: every ID is a fixed-size
+byte string; TaskIDs are derived from (parent task, submission counter) and
+ObjectIDs from (task, return/put index), so any process can compute the IDs
+of a task's returns without coordination.  TPU-era note: IDs are pure host
+metadata and never enter compiled XLA programs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+_UNIQUE_BYTES = 16
+
+
+def _hash(*parts: bytes) -> bytes:
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p)
+    return h.digest()[:_UNIQUE_BYTES]
+
+
+class BaseID:
+    """A fixed-width binary identifier with hex repr and value semantics."""
+
+    SIZE = _UNIQUE_BYTES
+    __slots__ = ("_bytes",)
+
+    def __init__(self, id_bytes: bytes):
+        if len(id_bytes) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(id_bytes)}"
+            )
+        self._bytes = bytes(id_bytes)
+
+    @classmethod
+    def from_random(cls) -> "BaseID":
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str) -> "BaseID":
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls) -> "BaseID":
+        return cls(b"\x00" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._bytes))
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()[:12]})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = 4
+
+    @classmethod
+    def from_int(cls, value: int) -> "JobID":
+        return cls(value.to_bytes(cls.SIZE, "big"))
+
+    def to_int(self) -> int:
+        return int.from_bytes(self._bytes, "big")
+
+
+class NodeID(BaseID):
+    pass
+
+
+class WorkerID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    SIZE = 12
+
+    @classmethod
+    def of(cls, job_id: JobID, parent_task_id: "TaskID", counter: int) -> "ActorID":
+        return cls(
+            _hash(b"actor", job_id.binary(), parent_task_id.binary(),
+                  counter.to_bytes(8, "big"))[: cls.SIZE]
+        )
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 12
+
+
+class TaskID(BaseID):
+    SIZE = 14
+
+    @classmethod
+    def for_driver(cls, job_id: JobID) -> "TaskID":
+        return cls(_hash(b"driver", job_id.binary())[: cls.SIZE])
+
+    @classmethod
+    def of(cls, job_id: JobID, parent_task_id: "TaskID", counter: int) -> "TaskID":
+        return cls(
+            _hash(b"task", job_id.binary(), parent_task_id.binary(),
+                  counter.to_bytes(8, "big"))[: cls.SIZE]
+        )
+
+    @classmethod
+    def for_actor_creation(cls, actor_id: ActorID) -> "TaskID":
+        return cls(_hash(b"actor_creation", actor_id.binary())[: cls.SIZE])
+
+
+class ObjectID(BaseID):
+    """ObjectID = hash(task_id, index).  index >= 1 for returns; put objects
+    use a separate namespace so puts and returns never collide."""
+
+    SIZE = 16
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, return_index: int) -> "ObjectID":
+        return cls(_hash(b"return", task_id.binary(), return_index.to_bytes(4, "big")))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        return cls(_hash(b"put", task_id.binary(), put_index.to_bytes(4, "big")))
+
+
+ObjectRefID = ObjectID  # alias used by the public ObjectRef type
+
+
+class _Counter:
+    """Thread-safe monotonically increasing counter (per task/actor context)."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._value += 1
+            return self._value

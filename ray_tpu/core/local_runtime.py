@@ -1,0 +1,224 @@
+"""Local-mode runtime: synchronous in-process execution.
+
+Role-equivalent to the reference's local_mode (ref:
+python/ray/_private/worker.py local mode paths): tasks run eagerly on
+submission in the driver process, actors are plain instances.  Used for
+debugging user code and as the executable spec of task semantics that the
+cluster runtime must match (the test suite runs the same semantic tests
+against both backends).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from .errors import (ActorDiedError, ActorError, GetTimeoutError, TaskError)
+from .ids import ActorID, ObjectID
+from .object_ref import ObjectRef
+from .runtime import BaseRuntime
+from .task import ArgKind, TaskKind, TaskSpec
+
+
+class _ActorSlot:
+    __slots__ = ("instance", "lock", "dead", "class_name", "creation_error",
+                 "registered_name")
+
+    def __init__(self, instance, class_name: str):
+        self.instance = instance
+        self.lock = threading.Lock()
+        self.dead = False
+        self.class_name = class_name
+        self.creation_error = None
+        self.registered_name = None  # (namespace, name) if named
+
+
+class LocalRuntime(BaseRuntime):
+    def __init__(self, config, job_id=None):
+        super().__init__(config, job_id)
+        self._store: Dict[ObjectID, Any] = {}
+        self._actors: Dict[ActorID, _ActorSlot] = {}
+        self._named: Dict[Tuple[str, str], Any] = {}
+        self._func_cache: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    # -- helpers ------------------------------------------------------------
+    def _load_func(self, spec: TaskSpec):
+        fn = self._func_cache.get(spec.func_id)
+        if fn is None:
+            fn = cloudpickle.loads(spec.func_blob)
+            self._func_cache[spec.func_id] = fn
+        return fn
+
+    def _resolve_args(self, spec: TaskSpec):
+        vals = []
+        for a in spec.args:
+            if a.kind == ArgKind.OBJECT_REF:
+                v = self._store.get(a.object_id, _MISSING)
+                if v is _MISSING:
+                    raise KeyError(f"Unknown object {a.object_id}")
+                if isinstance(v, TaskError):
+                    raise v
+                vals.append(v)
+            else:
+                # Round-trip through pickle so local mode has the same
+                # copy/isolation semantics as the cluster runtime.
+                vals.append(pickle.loads(cloudpickle.dumps(a.value)))
+        nkw = len(spec.kwargs_keys)
+        if nkw:
+            pos, kw_vals = vals[:-nkw], vals[-nkw:]
+            kwargs = dict(zip(spec.kwargs_keys, kw_vals))
+        else:
+            pos, kwargs = vals, {}
+        return pos, kwargs
+
+    def _store_returns(self, spec: TaskSpec, result: Any) -> List[ObjectRef]:
+        oids = spec.return_object_ids()
+        if spec.num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"Task {spec.display_name()} declared "
+                    f"num_returns={spec.num_returns} but returned "
+                    f"{len(values)} values")
+        with self._lock:
+            for oid, v in zip(oids, values):
+                self._store[oid] = v
+        return [ObjectRef(o) for o in oids]
+
+    def _store_error(self, spec: TaskSpec, err: TaskError) -> List[ObjectRef]:
+        oids = spec.return_object_ids()
+        with self._lock:
+            for oid in oids:
+                self._store[oid] = err
+        return [ObjectRef(o) for o in oids]
+
+    def _run_in_task_context(self, spec: TaskSpec, fn, *args, **kwargs):
+        prev = self._ctx.current_task_id
+        self.set_current_task(spec.task_id)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            self.set_current_task(prev)
+
+    # -- Runtime interface --------------------------------------------------
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        try:
+            fn = self._load_func(spec)
+            pos, kwargs = self._resolve_args(spec)
+            result = self._run_in_task_context(spec, fn, *pos, **kwargs)
+            return self._store_returns(spec, result)
+        except BaseException as e:  # noqa: BLE001 — stored, raised at get()
+            return self._store_error(spec, TaskError.from_exception(e))
+
+    def create_actor(self, spec: TaskSpec) -> None:
+        cls = self._load_func(spec)
+        try:
+            pos, kwargs = self._resolve_args(spec)
+            instance = self._run_in_task_context(spec, cls, *pos, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            slot = _ActorSlot(None, getattr(cls, "__name__", "?"))
+            slot.dead = True
+            slot.creation_error = TaskError.from_exception(e)
+            self._actors[spec.actor_id] = slot
+            return
+        slot = _ActorSlot(instance, type(instance).__name__)
+        self._actors[spec.actor_id] = slot
+        if spec.actor_name:
+            key = (spec.namespace, spec.actor_name)
+            if key in self._named:
+                raise ValueError(
+                    f"Actor name {spec.actor_name!r} already taken")
+            slot.registered_name = key
+            from .api import ActorHandle
+
+            handle = ActorHandle(
+                spec.actor_id, slot.class_name,
+                [n for n in dir(instance)
+                 if not n.startswith("_") and callable(getattr(instance, n))],
+                spec.namespace)
+            self._named[key] = handle
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        slot = self._actors.get(spec.actor_id)
+        if slot is None or slot.dead:
+            err = slot.creation_error if slot else None
+            if err is None:
+                err = ActorDiedError(spec.actor_id.hex())
+            return self._store_error(spec, err)
+        try:
+            with slot.lock:
+                method = getattr(slot.instance, spec.method_name)
+                pos, kwargs = self._resolve_args(spec)
+                result = self._run_in_task_context(spec, method, *pos, **kwargs)
+            return self._store_returns(spec, result)
+        except BaseException as e:  # noqa: BLE001
+            return self._store_error(spec, ActorError.from_exception(e))
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put(self.current_task_id(), self.next_put_index())
+        with self._lock:
+            self._store[oid] = value
+        return ObjectRef(oid, in_band=True)
+
+    def get(self, refs: List[ObjectRef],
+            timeout: Optional[float]) -> List[Any]:
+        out = []
+        for r in refs:
+            v = self._store.get(r.id, _MISSING)
+            if v is _MISSING:
+                raise KeyError(f"Unknown object {r}")
+            if isinstance(v, TaskError):
+                raise v
+            out.append(v)
+        return out
+
+    def wait(self, refs, num_returns, timeout, fetch_local):
+        # Local mode is synchronous: everything submitted is already done.
+        del timeout, fetch_local
+        return refs[:num_returns], refs[num_returns:]
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
+        slot = self._actors.get(actor_id)
+        if slot is not None:
+            slot.dead = True
+            slot.instance = None
+            if slot.registered_name is not None:
+                self._named.pop(slot.registered_name, None)
+                slot.registered_name = None
+
+    def get_named_actor(self, name: str, namespace: str = ""):
+        h = self._named.get((namespace, name))
+        if h is None:
+            raise ValueError(f"No actor named {name!r} in namespace "
+                             f"{namespace!r}")
+        return h
+
+    def cancel(self, ref: ObjectRef, force: bool) -> None:
+        pass  # local tasks already completed on submission
+
+    def cluster_resources(self) -> Dict[str, float]:
+        from .resources import node_resources
+
+        return node_resources().amounts
+
+    def available_resources(self) -> Dict[str, float]:
+        return self.cluster_resources()
+
+    def shutdown(self) -> None:
+        self._store.clear()
+        self._actors.clear()
+        self._named.clear()
+
+
+class _Missing:
+    __slots__ = ()
+
+
+_MISSING = _Missing()

@@ -1,0 +1,62 @@
+"""ObjectRef — a future/handle for a value in the distributed object plane.
+
+Role-equivalent to the reference's ObjectRef (ref: python/ray/_raylet.pyx
+ObjectRef, src/ray/common/ray_object.h).  Holding a ref pins the value via
+distributed reference counting; refs are awaitable through ``get``/``wait``
+and may be passed as arguments to remote calls, which forwards the borrow.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "_owner", "_in_band")
+
+    def __init__(self, object_id: ObjectID, owner: str = "", in_band: bool = False):
+        self.id = object_id
+        self._owner = owner
+        self._in_band = in_band  # True when created by local-mode put
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()[:16]})"
+
+    def __reduce__(self):
+        # Refs are routinely pickled into task args; the receiving runtime
+        # re-registers the borrow on deserialization (see worker context).
+        return (ObjectRef, (self.id, self._owner, self._in_band))
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        from . import runtime
+
+        return runtime.get_runtime().as_future(self)
+
+    def __await__(self):
+        from . import runtime
+
+        return runtime.get_runtime().await_ref(self).__await__()
+
+
+class ActorHandleRef:
+    """Marker wrapper used when an actor handle travels inside args."""
+
+    __slots__ = ("state",)
+
+    def __init__(self, state):
+        self.state = state

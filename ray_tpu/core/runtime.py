@@ -1,0 +1,159 @@
+"""Runtime context: the per-process face of the framework.
+
+Role-equivalent to the reference's CoreWorker + worker.py global state (ref:
+src/ray/core_worker/core_worker.h:166, python/ray/_private/worker.py).  A
+Runtime owns ID derivation (task counters per parent context), and the
+backend implementation of submit/get/put/wait.  Two backends exist:
+LocalRuntime (in-process, synchronous — the reference's local_mode) and
+ClusterRuntime (multiprocess controller/agent/worker tree).
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+from .config import RuntimeConfig
+from .ids import ActorID, JobID, TaskID, _Counter
+from .object_ref import ObjectRef
+from .task import TaskSpec
+
+_global_lock = threading.Lock()
+_global_runtime: Optional["BaseRuntime"] = None
+
+
+def get_runtime() -> "BaseRuntime":
+    rt = _global_runtime
+    if rt is None:
+        raise RuntimeError(
+            "ray_tpu.init() has not been called in this process.")
+    return rt
+
+
+def is_initialized() -> bool:
+    return _global_runtime is not None
+
+
+def set_runtime(rt: Optional["BaseRuntime"]) -> None:
+    global _global_runtime
+    with _global_lock:
+        _global_runtime = rt
+
+
+class _TaskContext(threading.local):
+    """Tracks the currently-executing task for child-ID derivation."""
+
+    def __init__(self):
+        self.current_task_id: Optional[TaskID] = None
+
+
+class BaseRuntime(abc.ABC):
+    def __init__(self, config: RuntimeConfig, job_id: Optional[JobID] = None):
+        self.config = config
+        self.job_id = job_id or JobID.from_int(1)
+        self._driver_task_id = TaskID.for_driver(self.job_id)
+        self._ctx = _TaskContext()
+        self._task_counter = _Counter()
+        self._actor_counter = _Counter()
+        self._put_counter = _Counter()
+        self._actor_seq: Dict[ActorID, _Counter] = {}
+        self._seq_lock = threading.Lock()
+
+    # -- ID derivation ------------------------------------------------------
+    def current_task_id(self) -> TaskID:
+        return self._ctx.current_task_id or self._driver_task_id
+
+    def set_current_task(self, task_id: Optional[TaskID]) -> None:
+        self._ctx.current_task_id = task_id
+
+    def next_task_id(self) -> TaskID:
+        return TaskID.of(self.job_id, self.current_task_id(),
+                         self._task_counter.next())
+
+    def next_actor_id(self) -> ActorID:
+        return ActorID.of(self.job_id, self.current_task_id(),
+                          self._actor_counter.next())
+
+    def actor_creation_task_id(self, actor_id: ActorID) -> TaskID:
+        return TaskID.for_actor_creation(actor_id)
+
+    def next_actor_task_id(self, actor_id: ActorID) -> TaskID:
+        # Actor-task IDs derive from the *caller's* context, not (actor, seq):
+        # two independent submitters each start their per-actor seq at 1, so a
+        # seq-derived ID would collide across callers.
+        del actor_id
+        return self.next_task_id()
+
+    def next_actor_seq(self, actor_id: ActorID) -> int:
+        with self._seq_lock:
+            c = self._actor_seq.get(actor_id)
+            if c is None:
+                c = self._actor_seq[actor_id] = _Counter()
+        return c.next()
+
+    def next_put_index(self) -> int:
+        return self._put_counter.next()
+
+    # -- Backend interface --------------------------------------------------
+    @abc.abstractmethod
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]: ...
+
+    @abc.abstractmethod
+    def create_actor(self, spec: TaskSpec) -> None: ...
+
+    @abc.abstractmethod
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]: ...
+
+    @abc.abstractmethod
+    def put(self, value: Any) -> ObjectRef: ...
+
+    @abc.abstractmethod
+    def get(self, refs: List[ObjectRef],
+            timeout: Optional[float]) -> List[Any]: ...
+
+    @abc.abstractmethod
+    def wait(self, refs: List[ObjectRef], num_returns: int,
+             timeout: Optional[float],
+             fetch_local: bool) -> Tuple[List[ObjectRef], List[ObjectRef]]: ...
+
+    @abc.abstractmethod
+    def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None: ...
+
+    @abc.abstractmethod
+    def get_named_actor(self, name: str, namespace: str = ""): ...
+
+    def cancel(self, ref: ObjectRef, force: bool) -> None:
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def shutdown(self) -> None: ...
+
+    # -- Introspection ------------------------------------------------------
+    def cluster_resources(self) -> Dict[str, float]:
+        return {}
+
+    def available_resources(self) -> Dict[str, float]:
+        return {}
+
+    def nodes(self) -> List[Dict[str, Any]]:
+        return []
+
+    # -- Async adapters -----------------------------------------------------
+    def as_future(self, ref: ObjectRef) -> Future:
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(self.get([ref], None)[0])
+            except BaseException as e:  # noqa: BLE001 — future carries it
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    async def await_ref(self, ref: ObjectRef):
+        import asyncio
+
+        return await asyncio.wrap_future(self.as_future(ref))

@@ -1,0 +1,101 @@
+"""Object serialization with zero-copy buffer extraction.
+
+Role-equivalent to the reference's serialization glue (ref:
+python/ray/_private/serialization.py): cloudpickle for code and arbitrary
+Python values, pickle protocol 5 out-of-band buffers so large numpy/JAX
+arrays are written into the shared-memory object plane without an extra
+copy.  JAX arrays are converted to host numpy on serialize (device transfer
+is explicit at the framework layer; objects in the store are host data).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+# Header layout of a stored object:
+#   u32 num_buffers | u64 pickled_len | pickled bytes |
+#   (u64 buf_len | buf bytes) * num_buffers
+_U32 = 4
+_U64 = 8
+
+
+def _to_host(value: Any) -> Any:
+    """Convert device arrays to host numpy before pickling (deep conversion
+    is handled by cloudpickle calling __reduce__; jax.Array reduces via
+    numpy conversion already, but doing it eagerly avoids importing jax in
+    the deserializing process)."""
+    t = type(value)
+    mod = t.__module__
+    if mod.startswith("jaxlib") or mod.startswith("jax"):
+        import numpy as np
+
+        try:
+            return np.asarray(value)
+        except Exception:
+            return value
+    return value
+
+
+def serialize(value: Any) -> Tuple[bytes, List[memoryview]]:
+    """Return (metadata_bytes, out_of_band_buffers)."""
+    buffers: List[pickle.PickleBuffer] = []
+    value = _to_host(value)
+    payload = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    views = [b.raw() for b in buffers]
+    return payload, views
+
+
+def pack(value: Any) -> bytes:
+    """Serialize into a single contiguous byte string (header + payload +
+    buffers) suitable for writing into one shared-memory segment."""
+    payload, views = serialize(value)
+    total = _U32 + _U64 + len(payload) + sum(_U64 + len(v) for v in views)
+    out = bytearray(total)
+    pos = 0
+    out[pos:pos + _U32] = len(views).to_bytes(_U32, "little"); pos += _U32
+    out[pos:pos + _U64] = len(payload).to_bytes(_U64, "little"); pos += _U64
+    out[pos:pos + len(payload)] = payload; pos += len(payload)
+    for v in views:
+        n = len(v)
+        out[pos:pos + _U64] = n.to_bytes(_U64, "little"); pos += _U64
+        out[pos:pos + n] = v; pos += n
+    return bytes(out)
+
+
+def pack_into(value: Any, buf: memoryview) -> int:
+    """Like pack() but writes directly into a preallocated buffer (the
+    shared-memory segment); returns bytes written."""
+    data = pack(value)
+    buf[: len(data)] = data
+    return len(data)
+
+
+def packed_size(payload: bytes, views: List[memoryview]) -> int:
+    return _U32 + _U64 + len(payload) + sum(_U64 + len(v) for v in views)
+
+
+def unpack(data) -> Any:
+    """Inverse of pack(); accepts bytes or memoryview, zero-copy for the
+    out-of-band buffers when given a memoryview over shared memory."""
+    view = memoryview(data)
+    pos = 0
+    nbuf = int.from_bytes(view[pos:pos + _U32], "little"); pos += _U32
+    plen = int.from_bytes(view[pos:pos + _U64], "little"); pos += _U64
+    payload = view[pos:pos + plen]; pos += plen
+    buffers = []
+    for _ in range(nbuf):
+        blen = int.from_bytes(view[pos:pos + _U64], "little"); pos += _U64
+        buffers.append(view[pos:pos + blen]); pos += blen
+    return pickle.loads(payload, buffers=buffers)
+
+
+def dumps_message(msg: Any) -> bytes:
+    """Control-plane message serialization (small, no out-of-band)."""
+    return cloudpickle.dumps(msg, protocol=5)
+
+
+def loads_message(data: bytes) -> Any:
+    return pickle.loads(data)

@@ -1,0 +1,109 @@
+"""Task specifications — the unit shipped from submitter to executor.
+
+Role-equivalent to the reference's TaskSpecification (ref:
+src/ray/common/task/task_spec.h, common.proto TaskSpec).  A spec carries the
+function (by content-hash into the cluster function table, so hot loops
+don't reship code), argument slots (inline value or object reference),
+resource demand, retry policy, and scheduling strategy.
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
+from .resources import ResourceSet
+
+
+class TaskKind(enum.Enum):
+    NORMAL = 0
+    ACTOR_CREATION = 1
+    ACTOR_TASK = 2
+
+
+class ArgKind(enum.Enum):
+    VALUE = 0      # inline serialized value
+    OBJECT_REF = 1  # must be resolved before dispatch
+
+
+@dataclass
+class TaskArg:
+    kind: ArgKind
+    value: Any = None                  # for VALUE (already picklable payload)
+    object_id: Optional[ObjectID] = None  # for OBJECT_REF
+
+
+@dataclass
+class SchedulingStrategy:
+    """Where a task may run.
+
+    Covers the reference's strategy set (ref:
+    python/ray/util/scheduling_strategies.py): default hybrid, SPREAD,
+    node-affinity, and placement-group bundles.
+    """
+
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP
+    node_id: Optional[NodeID] = None
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    kind: TaskKind
+    func_id: str                       # sha256 hex of the function blob
+    func_blob: Optional[bytes] = None  # present on first submission
+    method_name: str = ""              # for ACTOR_TASK
+    args: List[TaskArg] = field(default_factory=list)
+    kwargs_keys: List[str] = field(default_factory=list)  # trailing args are kwargs
+    num_returns: int = 1
+    resources: ResourceSet = field(default_factory=ResourceSet)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    name: str = ""
+    scheduling: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    runtime_env: Optional[Dict[str, Any]] = None
+    # Actor-specific.
+    actor_id: Optional[ActorID] = None
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    actor_name: str = ""               # named actor registration
+    namespace: str = ""
+    seq_no: int = 0                    # per-actor submission order
+    # Lineage: owner address is attached by the submitting worker.
+    owner_hint: str = ""
+
+    def return_object_ids(self) -> List[ObjectID]:
+        return [
+            ObjectID.for_task_return(self.task_id, i + 1)
+            for i in range(self.num_returns)
+        ]
+
+    def display_name(self) -> str:
+        if self.name:
+            return self.name
+        if self.kind == TaskKind.ACTOR_TASK:
+            return f"actor.{self.method_name}"
+        return self.func_id[:8]
+
+
+def func_id_of(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()
+
+
+@dataclass
+class TaskResult:
+    """Executor -> owner report for one finished task."""
+
+    task_id: TaskID
+    ok: bool
+    # Per-return: ("inline", payload_bytes) or ("store", object_id) entries.
+    returns: List[Tuple[str, Any]] = field(default_factory=list)
+    error: Optional[Any] = None  # serialized exception (TaskError)
+    worker_log: str = ""

@@ -1,0 +1,50 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform BEFORE any jax import, so
+multi-chip sharding paths (mesh, collectives, ring attention, pipeline) are
+exercised hermetically on one host — the TPU-era analogue of the
+reference's single-machine multi-raylet Cluster fixture (ref:
+python/ray/cluster_utils.py:135).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def local_runtime():
+    """In-process synchronous runtime (reference: local_mode)."""
+    import ray_tpu
+
+    rt = ray_tpu.init(mode="local", ignore_reinit_error=False)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def cluster_runtime():
+    """Single-node multiprocess runtime (controller + agent + workers)."""
+    import ray_tpu
+
+    rt = ray_tpu.init(mode="cluster", num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(params=["local", "cluster"])
+def any_runtime(request):
+    """Run a semantics test against both backends."""
+    import ray_tpu
+
+    kwargs = {"num_cpus": 4} if request.param == "cluster" else {}
+    rt = ray_tpu.init(mode=request.param, **kwargs)
+    yield rt
+    ray_tpu.shutdown()

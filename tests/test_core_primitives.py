@@ -1,0 +1,115 @@
+"""Unit tests for IDs, config, resources, serialization (SURVEY §4.1 style)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.config import RuntimeConfig
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu.core.resources import ResourceSet, node_resources, task_resources
+from ray_tpu.core.serialization import pack, unpack
+
+
+class TestIDs:
+    def test_deterministic_derivation(self):
+        job = JobID.from_int(7)
+        driver = TaskID.for_driver(job)
+        t1 = TaskID.of(job, driver, 1)
+        t1b = TaskID.of(job, driver, 1)
+        t2 = TaskID.of(job, driver, 2)
+        assert t1 == t1b and t1 != t2
+
+    def test_return_ids_computable_by_anyone(self):
+        job = JobID.from_int(1)
+        t = TaskID.of(job, TaskID.for_driver(job), 5)
+        a = ObjectID.for_task_return(t, 1)
+        b = ObjectID.for_task_return(t, 1)
+        c = ObjectID.for_task_return(t, 2)
+        assert a == b and a != c
+
+    def test_put_and_return_namespaces_disjoint(self):
+        job = JobID.from_int(1)
+        t = TaskID.of(job, TaskID.for_driver(job), 1)
+        assert ObjectID.for_put(t, 1) != ObjectID.for_task_return(t, 1)
+
+    def test_pickle_roundtrip(self):
+        a = ActorID.from_random()
+        assert pickle.loads(pickle.dumps(a)) == a
+
+    def test_hex_roundtrip(self):
+        t = TaskID.from_random()
+        assert TaskID.from_hex(t.hex()) == t
+
+
+class TestConfig:
+    def test_defaults_and_overrides(self):
+        cfg = RuntimeConfig.from_env({"max_task_retries": 9})
+        assert cfg.max_task_retries == 9
+        assert cfg.raylet_heartbeat_period_ms == 1000
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("RT_MAX_TASK_RETRIES", "5")
+        cfg = RuntimeConfig.from_env()
+        assert cfg.max_task_retries == 5
+
+    def test_json_roundtrip(self):
+        cfg = RuntimeConfig.from_env({"tracing_enabled": True})
+        cfg2 = RuntimeConfig.from_json(cfg.to_json())
+        assert cfg2.tracing_enabled is True
+
+    def test_unknown_flag_rejected(self):
+        with pytest.raises(KeyError):
+            RuntimeConfig.from_env({"nope": 1})
+
+
+class TestResources:
+    def test_covers_and_subtract(self):
+        total = ResourceSet({"CPU": 4, "TPU": 8})
+        demand = ResourceSet({"CPU": 1, "TPU": 4})
+        assert total.covers(demand)
+        rem = total.subtract(demand)
+        assert rem.get("TPU") == 4
+        assert not rem.covers(ResourceSet({"TPU": 5}))
+
+    def test_subtract_negative_raises(self):
+        with pytest.raises(ValueError):
+            ResourceSet({"CPU": 1}).subtract(ResourceSet({"CPU": 2}))
+
+    def test_task_resources_default_cpu(self):
+        r = task_resources()
+        assert r.get("CPU") == 1.0
+
+    def test_node_resources_explicit(self):
+        r = node_resources(num_cpus=2, num_tpus=4)
+        assert r.get("CPU") == 2 and r.get("TPU") == 4
+
+    def test_utilization(self):
+        total = ResourceSet({"CPU": 4})
+        avail = ResourceSet({"CPU": 1})
+        assert abs(avail.utilization(total) - 0.75) < 1e-9
+
+
+class TestSerialization:
+    def test_roundtrip_simple(self):
+        data = {"a": [1, 2, 3], "b": "hello"}
+        assert unpack(pack(data)) == data
+
+    def test_numpy_zero_copy_buffers(self):
+        arr = np.arange(1024, dtype=np.float32).reshape(32, 32)
+        blob = pack(arr)
+        out = unpack(blob)
+        np.testing.assert_array_equal(arr, out)
+
+    def test_large_array(self):
+        arr = np.random.default_rng(0).normal(size=(256, 256))
+        out = unpack(pack({"w": arr, "meta": 3}))
+        np.testing.assert_array_equal(out["w"], arr)
+        assert out["meta"] == 3
+
+    def test_memoryview_input(self):
+        arr = np.arange(100)
+        blob = pack(arr)
+        out = unpack(memoryview(blob))
+        np.testing.assert_array_equal(out, arr)

@@ -1,0 +1,234 @@
+"""Task/actor/object semantics in local mode (the executable spec that the
+cluster backend must also satisfy — see test_cluster_mode.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def _rt():
+    ray_tpu.init(mode="local")
+    yield
+    ray_tpu.shutdown()
+
+
+def test_simple_task():
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_kwargs():
+    @ray_tpu.remote
+    def f(a, b=10, c=0):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1, c=5)) == 16
+
+
+def test_multiple_returns():
+    @ray_tpu.remote(num_returns=2)
+    def two():
+        return 1, 2
+
+    r1, r2 = two.remote()
+    assert ray_tpu.get(r1) == 1 and ray_tpu.get(r2) == 2
+
+
+def test_put_get():
+    ref = ray_tpu.put({"x": np.arange(5)})
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(out["x"], np.arange(5))
+
+
+def test_ref_as_arg_resolves():
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    a = inc.remote(0)
+    b = inc.remote(a)
+    c = inc.remote(b)
+    assert ray_tpu.get(c) == 3
+
+
+def test_nested_task_submission():
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_error_propagates_with_original_type():
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("bad input")
+
+    ref = boom.remote()
+    with pytest.raises(ValueError):
+        ray_tpu.get(ref)
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(ref)
+
+
+def test_dependency_failure_propagates():
+    @ray_tpu.remote
+    def boom():
+        raise RuntimeError("upstream")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    ref = consume.remote(boom.remote())
+    with pytest.raises(RuntimeError):
+        ray_tpu.get(ref)
+
+
+def test_actor_basic():
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.v = start
+
+        def incr(self, by=1):
+            self.v += by
+            return self.v
+
+        def value(self):
+            return self.v
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.incr.remote()) == 11
+    assert ray_tpu.get(c.incr.remote(5)) == 16
+    assert ray_tpu.get(c.value.remote()) == 16
+
+
+def test_actor_error():
+    @ray_tpu.remote
+    class A:
+        def fail(self):
+            raise KeyError("nope")
+
+    a = A.remote()
+    with pytest.raises(KeyError):
+        ray_tpu.get(a.fail.remote())
+
+
+def test_actor_kill():
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    ray_tpu.kill(a)
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(a.ping.remote())
+
+
+def test_named_actor():
+    @ray_tpu.remote
+    class Registry:
+        def who(self):
+            return "registry"
+
+    Registry.options(name="reg").remote()
+    h = ray_tpu.get_actor("reg")
+    assert ray_tpu.get(h.who.remote()) == "registry"
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("missing")
+
+
+def test_wait():
+    @ray_tpu.remote
+    def f(i):
+        return i
+
+    refs = [f.remote(i) for i in range(4)]
+    ready, rest = ray_tpu.wait(refs, num_returns=2)
+    assert len(ready) == 2 and len(rest) == 2
+
+
+def test_options_override():
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(name="custom").remote()) == 1
+
+
+def test_direct_call_rejected():
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_args_are_isolated_copies():
+    @ray_tpu.remote
+    def mutate(d):
+        d["x"] = 99
+        return d["x"]
+
+    d = {"x": 1}
+    assert ray_tpu.get(mutate.remote(d)) == 99
+    assert d["x"] == 1  # caller's dict untouched (process-boundary semantics)
+
+
+def test_numpy_roundtrip_through_task():
+    @ray_tpu.remote
+    def double(a):
+        return a * 2
+
+    arr = np.arange(16, dtype=np.float32)
+    np.testing.assert_array_equal(ray_tpu.get(double.remote(arr)), arr * 2)
+
+
+def test_actor_creation_failure_deferred():
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise ValueError("init failed")
+
+        def ping(self):
+            return "pong"
+
+    b = Bad.remote()  # must not raise here
+    with pytest.raises(ValueError):
+        ray_tpu.get(b.ping.remote())
+
+
+def test_kill_releases_actor_name():
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(name="reusable").remote()
+    ray_tpu.kill(a)
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("reusable")
+    a2 = A.options(name="reusable").remote()  # name is free again
+    assert ray_tpu.get(a2.ping.remote()) == "pong"
+
+
+def test_wait_empty_list():
+    assert ray_tpu.wait([]) == ([], [])
+
+
+def test_no_namespace_pollution():
+    assert not hasattr(ray_tpu, "traceback")
+    assert not hasattr(ray_tpu, "annotations")
