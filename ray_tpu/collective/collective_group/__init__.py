@@ -1,0 +1,1 @@
+"""Collective backend implementations (CPU host TCP, XLA device mesh)."""

@@ -1,0 +1,253 @@
+"""Host (CPU) collective group over TCP — the GLOO-equivalent backend.
+
+Role-equivalent to the reference's gloo_collective_group (ref:
+python/ray/util/collective/collective_group/gloo_collective_group.py):
+control-plane tensor collectives between processes that do not need the
+device plane.  Topology: rank 0 is the hub for reductions/broadcasts
+(star), point-to-point send/recv is direct.  All ranks must issue the
+same sequence of collective calls (SPMD discipline), so ops need no tags
+— sockets deliver them in lockstep order.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..types import ReduceOp
+
+_LEN = struct.Struct("<Q")
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=5)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(header)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("collective peer closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _reduce(arrays: List[np.ndarray], op: ReduceOp) -> np.ndarray:
+    out = np.array(arrays[0], copy=True)
+    for a in arrays[1:]:
+        if op in (ReduceOp.SUM, ReduceOp.MEAN):
+            out += a
+        elif op == ReduceOp.PRODUCT:
+            out *= a
+        elif op == ReduceOp.MAX:
+            np.maximum(out, a, out=out)
+        elif op == ReduceOp.MIN:
+            np.minimum(out, a, out=out)
+    if op == ReduceOp.MEAN:
+        out = out / len(arrays)
+    return out
+
+
+class CPUGroup:
+    """One rank's membership in a named host collective group."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int,
+                 store):
+        """``store`` is a rendezvous KV with set(key, value) / get(key)
+        (the named-actor pattern, ref: collective.py:151 creating the
+        "Info" actor)."""
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._store = store
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(world_size + 4)
+        self._port = self._listener.getsockname()[1]
+        self._peers: Dict[int, socket.socket] = {}
+        self._p2p_in: Dict[int, "queue.Queue[Any]"] = {}
+        self._p2p_lock = threading.Lock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+        self._closed = False
+        store.set(f"col/{group_name}/{rank}", f"127.0.0.1:{self._port}")
+        if rank == 0:
+            self._await_hub_connections()
+        else:
+            self._hub = self._dial(0)
+
+    # ---------------------------------------------------------- connections
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            hello = _recv_msg(conn)
+            peer_rank = hello["rank"]
+            kind = hello["kind"]
+            if kind == "hub":
+                self._peers[peer_rank] = conn
+            else:  # p2p inbound: pump into a queue per source
+                q = self._p2p_queue(peer_rank)
+                t = threading.Thread(target=self._pump, args=(conn, q),
+                                     daemon=True)
+                t.start()
+
+    def _pump(self, conn: socket.socket, q: "queue.Queue[Any]") -> None:
+        try:
+            while True:
+                q.put(_recv_msg(conn))
+        except (ConnectionError, OSError):
+            pass
+
+    def _p2p_queue(self, peer: int) -> "queue.Queue[Any]":
+        with self._p2p_lock:
+            q = self._p2p_in.get(peer)
+            if q is None:
+                q = self._p2p_in[peer] = queue.Queue()
+            return q
+
+    def _peer_addr(self, rank: int, timeout: float = 60.0) -> str:
+        deadline = time.time() + timeout
+        key = f"col/{self.group_name}/{rank}"
+        while time.time() < deadline:
+            addr = self._store.get(key)
+            if addr:
+                return addr
+            time.sleep(0.02)
+        raise TimeoutError(f"rank {rank} never registered in group "
+                           f"{self.group_name!r}")
+
+    def _dial(self, rank: int, kind: str = "hub") -> socket.socket:
+        host, port = self._peer_addr(rank).rsplit(":", 1)
+        deadline = time.time() + 60
+        while True:
+            try:
+                sock = socket.create_connection((host, int(port)), timeout=10)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_msg(sock, {"rank": self.rank, "kind": kind})
+        return sock
+
+    def _await_hub_connections(self, timeout: float = 120.0) -> None:
+        deadline = time.time() + timeout
+        while len(self._peers) < self.world_size - 1:
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"only {len(self._peers)}/{self.world_size - 1} peers "
+                    f"joined group {self.group_name!r}")
+            time.sleep(0.01)
+
+    # ------------------------------------------------------------ ops (hub)
+    def allreduce(self, array, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        array = np.asarray(array)
+        if self.world_size == 1:
+            return _reduce([array], op)
+        if self.rank == 0:
+            parts = [array]
+            for r in range(1, self.world_size):
+                parts.append(_recv_msg(self._peers[r]))
+            out = _reduce(parts, op)
+            for r in range(1, self.world_size):
+                _send_msg(self._peers[r], out)
+            return out
+        _send_msg(self._hub, array)
+        return _recv_msg(self._hub)
+
+    def allgather(self, array) -> List[np.ndarray]:
+        array = np.asarray(array)
+        if self.world_size == 1:
+            return [array]
+        if self.rank == 0:
+            parts = [array] + [None] * (self.world_size - 1)
+            for r in range(1, self.world_size):
+                parts[r] = _recv_msg(self._peers[r])
+            for r in range(1, self.world_size):
+                _send_msg(self._peers[r], parts)
+            return parts
+        _send_msg(self._hub, array)
+        return _recv_msg(self._hub)
+
+    def reducescatter(self, array, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        """Reduce then return this rank's 1/world_size shard (axis 0)."""
+        array = np.asarray(array)
+        total = self.allreduce(array, op)
+        shards = np.array_split(total, self.world_size, axis=0)
+        return shards[self.rank]
+
+    def broadcast(self, array, src_rank: int = 0) -> np.ndarray:
+        if self.world_size == 1:
+            return np.asarray(array)
+        if self.rank == 0:
+            if src_rank == 0:
+                data = np.asarray(array)
+            else:
+                data = _recv_msg(self._peers[src_rank])
+            for r in range(1, self.world_size):
+                _send_msg(self._peers[r], data)
+            return data
+        if self.rank == src_rank:
+            _send_msg(self._hub, np.asarray(array))
+        return _recv_msg(self._hub)
+
+    def barrier(self) -> None:
+        self.allreduce(np.zeros(1, dtype=np.int8))
+
+    # ------------------------------------------------------------- ops (p2p)
+    def send(self, array, dst_rank: int) -> None:
+        sock = getattr(self, "_p2p_out", None)
+        if sock is None:
+            self._p2p_out: Dict[int, socket.socket] = {}
+        conn = self._p2p_out.get(dst_rank)
+        if conn is None:
+            conn = self._p2p_out[dst_rank] = self._dial(dst_rank, "p2p")
+        _send_msg(conn, np.asarray(array))
+
+    def recv(self, src_rank: int, timeout: float = 120.0) -> np.ndarray:
+        return self._p2p_queue(src_rank).get(timeout=timeout)
+
+    def destroy(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for sock in list(self._peers.values()):
+            try:
+                sock.close()
+            except OSError:
+                pass
+        hub = getattr(self, "_hub", None)
+        if hub is not None:
+            try:
+                hub.close()
+            except OSError:
+                pass
+        for conn in getattr(self, "_p2p_out", {}).values():
+            try:
+                conn.close()
+            except OSError:
+                pass

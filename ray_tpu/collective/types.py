@@ -1,0 +1,53 @@
+"""Collective types and backend registry.
+
+Role-equivalent to the reference's ray.util.collective.types (ref:
+python/ray/util/collective/types.py:29-44 Backend enum that validates
+NCCL/GLOO and rejects MPI).  The TPU build ships:
+
+- ``Backend.XLA`` — jax collectives over the device mesh (ICI within a
+  slice, DCN across slices via jax.distributed) — the NCCL replacement.
+- ``Backend.CPU`` — host TCP collectives for control-plane tensors — the
+  GLOO replacement.
+
+NCCL is rejected by name with a pointer to XLA, the mirror image of the
+reference rejecting MPI.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class Backend(str, enum.Enum):
+    XLA = "xla"
+    CPU = "cpu"
+
+    @classmethod
+    def parse(cls, name: str) -> "Backend":
+        low = str(name).lower()
+        if low in ("xla", "tpu", "jax"):
+            return cls.XLA
+        if low in ("cpu", "host", "gloo"):
+            return cls.CPU
+        if low in ("nccl", "cuda"):
+            raise ValueError(
+                "NCCL is a CUDA-only backend; this framework is TPU-native "
+                "— use backend='xla' for device collectives over ICI.")
+        raise ValueError(f"Unknown collective backend {name!r}")
+
+
+class ReduceOp(str, enum.Enum):
+    SUM = "sum"
+    PRODUCT = "prod"
+    MAX = "max"
+    MIN = "min"
+    MEAN = "mean"
+
+
+@dataclass
+class GroupInfo:
+    group_name: str
+    world_size: int
+    rank: int
+    backend: Backend

@@ -78,6 +78,37 @@ def _strategy(opts: Dict[str, Any]) -> SchedulingStrategy:
         return SchedulingStrategy(kind="SPREAD")
     if s == "DEFAULT":
         return SchedulingStrategy()
+    # User-facing strategy objects (ref: util/scheduling_strategies.py).
+    kind = type(s).__name__
+    if kind == "PlacementGroupSchedulingStrategy":
+        if getattr(s, "placement_group_capture_child_tasks", False):
+            raise NotImplementedError(
+                "placement_group_capture_child_tasks is not supported yet; "
+                "bind child tasks explicitly with their own "
+                "PlacementGroupSchedulingStrategy")
+        return SchedulingStrategy(
+            kind="PLACEMENT_GROUP",
+            placement_group_id=s.placement_group.id,
+            bundle_index=s.placement_group_bundle_index)
+    if kind == "NodeAffinitySchedulingStrategy":
+        return SchedulingStrategy(kind="NODE_AFFINITY",
+                                  node_id=s.to_node_id(), soft=s.soft)
+    if kind == "NodeLabelSchedulingStrategy":
+        # Resolve hard labels to a concrete node now (labels are static
+        # per node: TPU slice/pod identity).
+        from . import runtime as _rt
+
+        nodes = _rt.get_runtime().nodes()
+        hard = s.hard or {}
+        for n in nodes:
+            if n["Alive"] and all(n["Labels"].get(k) == v
+                                  for k, v in hard.items()):
+                from .ids import NodeID
+
+                return SchedulingStrategy(
+                    kind="NODE_AFFINITY",
+                    node_id=NodeID.from_hex(n["NodeID"]), soft=False)
+        raise ValueError(f"no alive node matches labels {hard!r}")
     raise ValueError(f"Unknown scheduling strategy {s!r}")
 
 
@@ -162,11 +193,13 @@ class ActorHandle:
     """Client-side handle to a live actor; picklable into tasks."""
 
     def __init__(self, actor_id: ActorID, class_name: str,
-                 method_names: List[str], namespace: str = ""):
+                 method_names: List[str], namespace: str = "",
+                 max_concurrency: int = 1):
         self._actor_id = actor_id
         self._class_name = class_name
         self._method_names = list(method_names)
         self._namespace = namespace
+        self._max_concurrency = max_concurrency
 
     @property
     def actor_id(self) -> ActorID:
@@ -194,6 +227,7 @@ class ActorHandle:
             num_returns=num_returns,
             actor_id=self._actor_id,
             seq_no=rt.next_actor_seq(self._actor_id),
+            max_concurrency=self._max_concurrency,
             name=f"{self._class_name}.{method}",
         )
         refs = rt.submit_actor_task(spec)
@@ -204,7 +238,8 @@ class ActorHandle:
 
     def __reduce__(self):
         return (ActorHandle, (self._actor_id, self._class_name,
-                              self._method_names, self._namespace))
+                              self._method_names, self._namespace,
+                              self._max_concurrency))
 
 
 class ActorClass:
@@ -244,6 +279,7 @@ class ActorClass:
                 pass
         func_id, blob = self._ensure_blob()
         actor_id = rt.next_actor_id()
+        method_names = self._method_names()
         task_args, kw_keys = _build_args(args, kwargs)
         res = task_resources(
             opts["num_cpus"], opts["num_tpus"], opts["memory"],
@@ -263,13 +299,21 @@ class ActorClass:
             actor_id=actor_id,
             actor_name=name,
             namespace=opts["namespace"],
+            method_names=method_names,
+            lifetime=opts["lifetime"],
             name=f"{self._cls.__name__}.__init__",
             scheduling=_strategy(opts),
             runtime_env=opts["runtime_env"],
         )
-        rt.create_actor(spec)
-        return ActorHandle(actor_id, self._cls.__name__, self._method_names(),
-                           opts["namespace"])
+        try:
+            rt.create_actor(spec)
+        except ValueError:
+            if name and opts["get_if_exists"]:
+                # Lost a creation race; return the winner's handle.
+                return rt.get_named_actor(name, opts["namespace"])
+            raise
+        return ActorHandle(actor_id, self._cls.__name__, method_names,
+                           opts["namespace"], opts["max_concurrency"])
 
     def __call__(self, *a, **kw):
         raise TypeError(

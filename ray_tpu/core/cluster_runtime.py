@@ -1,0 +1,746 @@
+"""ClusterRuntime — the in-process core of every driver and worker.
+
+Role-equivalent to the reference's CoreWorker (ref:
+src/ray/core_worker/core_worker.h:166 with SubmitTask at
+core_worker.cc:2484, NormalTaskSubmitter transport/normal_task_submitter.h:74,
+ActorTaskSubmitter transport/actor_task_submitter.h:75): owns the
+per-process memory store, resolves dependencies, leases workers from the
+node agent, pushes tasks directly to leased workers, and routes actor
+calls straight to the actor's worker process.  All IO runs on a dedicated
+event-loop thread so user threads only ever block on local events.
+
+Head-node bring-up (controller + agent subprocesses) mirrors
+python/ray/_private/node.py:1407 start_head_processes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .config import RuntimeConfig
+from .errors import (ActorDiedError, ActorError, GetTimeoutError,
+                     ObjectLostError, TaskError, WorkerCrashedError)
+from .ids import ActorID, JobID, NodeID, ObjectID
+from .object_store import MemoryStore, SharedObjectStore
+from .object_ref import ObjectRef
+from .rpc import EventLoopThread, RemoteCallError, RpcClient, RpcError
+from .runtime import BaseRuntime
+from .task import ArgKind, TaskArg, TaskKind, TaskResult, TaskSpec
+
+_PUSH_RETRY_STATES = ("PENDING", "RESTARTING")
+
+
+class _StoreRef:
+    """Memory-store descriptor for a value living in the object plane."""
+
+    __slots__ = ("size", "node_hint")
+
+    def __init__(self, size: int, node_hint: str = ""):
+        self.size = size
+        self.node_hint = node_hint
+
+
+class ClusterRuntime(BaseRuntime):
+    def __init__(self, config: RuntimeConfig, *,
+                 address: Optional[str] = None,
+                 num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 custom_resources: Optional[Dict[str, float]] = None,
+                 namespace: str = "",
+                 # Worker-role wiring (set by worker_main):
+                 _connect: Optional[Dict[str, str]] = None,
+                 _job_id: Optional[JobID] = None):
+        self._procs: List[subprocess.Popen] = []
+        self._owns_head = False
+        self.namespace = namespace
+        self.is_worker = _connect is not None
+        if _connect is not None:
+            self.session = _connect["session"]
+            self.controller_addr = _connect["controller"]
+            self.agent_addr = _connect["agent"]
+        elif address is not None:
+            self.session, self.controller_addr, self.agent_addr = \
+                self._connect_existing(config, address, num_cpus, num_tpus,
+                                       custom_resources)
+        else:
+            self.session, self.controller_addr, self.agent_addr = \
+                self._start_head(config, num_cpus, num_tpus,
+                                 custom_resources)
+            self._owns_head = True
+        self.io = EventLoopThread("rt-io")
+        self.store = SharedObjectStore(self.session)
+        self.memory = MemoryStore()
+        self._runtime_id = uuid.uuid4().hex[:16]
+        self._ctl: Optional[RpcClient] = None
+        self._agent: Optional[RpcClient] = None
+        self._worker_clients: Dict[str, RpcClient] = {}
+        self._actor_cache: Dict[ActorID, Dict] = {}
+        self._pending_returns: Set[ObjectID] = set()
+        self._completion_events: Dict[ObjectID, asyncio.Event] = {}
+        self._pending_lock = threading.Lock()
+        self._actor_submit_locks: Dict[ActorID, asyncio.Lock] = {}
+        self._shutdown_flag = False
+        self._event_cursor = 0
+        # Worker-role: current lease for blocked-CPU accounting.
+        self.current_lease_id: Optional[int] = None
+        self.io.run(self._async_init())
+        job_id = _job_id
+        if job_id is None:
+            r = self.io.run(self._ctl.call("register_job",
+                                           {"driver": f"pid-{os.getpid()}"}))
+            job_id = JobID.from_int(r["job_id"])
+        super().__init__(config, job_id)
+        if not self.is_worker:
+            self.io.spawn(self._event_poll_loop())
+
+    # ----------------------------------------------------------- bring-up
+    @staticmethod
+    def _session_name() -> str:
+        return f"{int(time.time())}_{os.getpid()}"
+
+    def _start_head(self, config, num_cpus, num_tpus, custom):
+        from . import node_launcher
+
+        session = self._session_name()
+        proc, controller_addr = node_launcher.start_controller(
+            config, session, driver_pid=os.getpid())
+        self._procs.append(proc)
+        proc, agent_addr, _nid = node_launcher.start_node_agent(
+            config, session, controller_addr, num_cpus=num_cpus,
+            num_tpus=num_tpus, custom_resources=custom, is_head=True,
+            tag="head")
+        self._procs.append(proc)
+        return session, controller_addr, agent_addr
+
+    def _connect_existing(self, config, address, num_cpus, num_tpus, custom):
+        """Driver connecting to a running cluster; needs a colocated agent.
+        Starts one if this host has none (matching ray.init(address=...)
+        semantics where the driver machine must run a raylet)."""
+        probe = EventLoopThread("rt-probe")
+        try:
+            cli = RpcClient(address, connect_timeout=10.0)
+            info = probe.run(self._probe(cli))
+            session = info["session"]
+            nodes = info["nodes"]
+            agent_addr = None
+            for n in nodes:
+                if n["alive"] and n["agent_addr"].startswith("127.0.0.1"):
+                    agent_addr = n["agent_addr"]
+                    break
+            if agent_addr is None:
+                raise RuntimeError("no local node agent found to attach to")
+            return session, address, agent_addr
+        finally:
+            probe.stop()
+
+    @staticmethod
+    async def _probe(cli: RpcClient):
+        pong = await cli.call("ping")
+        nodes = await cli.call("list_nodes", {})
+        await cli.close()
+        return {"session": pong["session"], "nodes": nodes}
+
+    async def _async_init(self):
+        self._ctl = RpcClient(self.controller_addr,
+                              tag=f"rt-{os.getpid()}")
+        await self._ctl.connect()
+        self._agent = RpcClient(self.agent_addr, tag=f"rt-{os.getpid()}")
+        await self._agent.connect()
+
+    # ------------------------------------------------------------- helpers
+    def _completion_event(self, oid: ObjectID) -> asyncio.Event:
+        ev = self._completion_events.get(oid)
+        if ev is None:
+            ev = self._completion_events[oid] = asyncio.Event()
+        return ev
+
+    def _mark_pending(self, oids: List[ObjectID]) -> None:
+        with self._pending_lock:
+            self._pending_returns.update(oids)
+
+    def _store_result_value(self, oid: ObjectID, value: Any) -> None:
+        self.memory.put(oid, value)
+        with self._pending_lock:
+            self._pending_returns.discard(oid)
+        ev = self._completion_events.get(oid)
+        if ev is not None:
+            ev.set()
+
+    async def _worker_client(self, addr: str) -> RpcClient:
+        cli = self._worker_clients.get(addr)
+        if cli is None or not cli.connected:
+            cli = RpcClient(addr, tag=f"owner-{self._runtime_id}",
+                            connect_timeout=10.0)
+            await cli.connect()
+            self._worker_clients[addr] = cli
+        return cli
+
+    async def _event_poll_loop(self):
+        """Long-poll controller pubsub to invalidate actor caches (ref:
+        src/ray/pubsub long-poll subscriber)."""
+        while not self._shutdown_flag:
+            try:
+                r = await self._ctl.call("poll_events", {
+                    "cursor": self._event_cursor,
+                    "channels": ["actor", "node"], "timeout": 10.0},
+                    timeout=15.0)
+            except (RpcError, asyncio.TimeoutError, RemoteCallError):
+                await asyncio.sleep(0.5)
+                continue
+            self._event_cursor = r.get("cursor", self._event_cursor)
+            for _seq, ch, data in r.get("events", []):
+                if ch == "actor":
+                    aid = data["actor_id"]
+                    cached = self._actor_cache.get(aid)
+                    if cached is not None:
+                        cached["state"] = data["state"]
+                        cached["worker_addr"] = data.get("worker_addr", "")
+
+    # ------------------------------------------------- dependency resolution
+    async def _resolve_deps(self, spec: TaskSpec) -> None:
+        """Owner-side resolution (ref: dependency_resolver.h): wait for
+        owned pending refs; inline small owned values; leave plane refs for
+        the executor to pull."""
+        for arg in spec.args:
+            if arg.kind != ArgKind.OBJECT_REF:
+                continue
+            oid = arg.object_id
+            with self._pending_lock:
+                pending = oid in self._pending_returns
+            if pending:
+                await self._completion_event(oid).wait()
+            ok, val = self.memory.get_nowait(oid)
+            if ok and not isinstance(val, _StoreRef):
+                if isinstance(val, TaskError):
+                    raise val
+                arg.kind = ArgKind.VALUE
+                arg.value = val
+                arg.object_id = None
+
+    # ------------------------------------------------------- normal tasks
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        oids = spec.return_object_ids()
+        self._mark_pending(oids)
+        self.io.call_soon(lambda: self.io.loop.create_task(
+            self._submit_normal(spec)))
+        return [ObjectRef(o) for o in oids]
+
+    async def _submit_normal(self, spec: TaskSpec) -> None:
+        try:
+            await self._resolve_deps(spec)
+        except TaskError as e:
+            self._fail_returns(spec, e)
+            return
+        attempts_left = spec.max_retries
+        delay = self.config.task_retry_delay_ms / 1000.0
+        while True:
+            try:
+                result = await self._lease_and_push(spec)
+            except (RpcError, WorkerCrashedError) as e:
+                if attempts_left != 0:
+                    if attempts_left > 0:
+                        attempts_left -= 1
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+                    continue
+                self._fail_returns(spec, TaskError.from_exception(
+                    WorkerCrashedError(str(e))))
+                return
+            except RemoteCallError as e:
+                self._fail_returns(spec, TaskError.from_exception(e.cause))
+                return
+            if not result.ok:
+                err = result.error
+                if spec.retry_exceptions and attempts_left != 0:
+                    if attempts_left > 0:
+                        attempts_left -= 1
+                    await asyncio.sleep(delay)
+                    continue
+                self._fail_returns(spec, err if isinstance(err, TaskError)
+                                   else TaskError.from_exception(err))
+                return
+            self._accept_returns(spec, result)
+            return
+
+    async def _lease_and_push(self, spec: TaskSpec) -> TaskResult:
+        payload = {
+            "resources": dict(spec.resources.amounts),
+            "strategy": spec.scheduling.kind,
+        }
+        if spec.scheduling.kind == "PLACEMENT_GROUP":
+            payload["pg_id"] = spec.scheduling.placement_group_id
+            payload["bundle_index"] = spec.scheduling.bundle_index
+        agent_addr = self.agent_addr
+        if spec.scheduling.kind == "NODE_AFFINITY" and \
+                spec.scheduling.node_id is not None:
+            addr = await self._agent_addr_of(spec.scheduling.node_id)
+            if addr is not None:
+                agent_addr = addr
+                payload["no_spill"] = True
+        if spec.scheduling.kind == "PLACEMENT_GROUP":
+            addr = await self._pg_agent_addr(payload["pg_id"],
+                                             payload["bundle_index"])
+            if addr is not None:
+                agent_addr = addr
+        # Lease loop with spillback redirects (ref:
+        # normal_task_submitter.h:182 RequestNewWorkerIfNeeded).
+        hops = 0
+        while True:
+            agent = await self._agent_for(agent_addr)
+            grant = await agent.call("request_lease", payload)
+            if grant.get("ok"):
+                break
+            if grant.get("retry_at") and hops < 8:
+                agent_addr = grant["retry_at"]
+                hops += 1
+                payload["no_spill"] = hops >= 4
+                continue
+            raise RemoteCallError(ValueError(
+                grant.get("error", "lease request failed")))
+        lease_id = grant["lease_id"]
+        try:
+            worker = await self._worker_client(grant["worker_addr"])
+            reply = await worker.call("push_task", {
+                "spec": spec, "chip_ids": grant.get("chip_ids", []),
+                "lease_id": lease_id})
+            return reply
+        finally:
+            try:
+                await agent.call("return_lease", {"lease_id": lease_id})
+            except RpcError:
+                pass
+
+    _peer_agent_clients: Dict[str, RpcClient]
+
+    async def _agent_for(self, addr: str) -> RpcClient:
+        if addr == self.agent_addr:
+            return self._agent
+        if not hasattr(self, "_peer_agent_clients"):
+            self._peer_agent_clients = {}
+        cli = self._peer_agent_clients.get(addr)
+        if cli is None or not cli.connected:
+            cli = RpcClient(addr, tag=f"rt-peer-{self._runtime_id}")
+            await cli.connect()
+            self._peer_agent_clients[addr] = cli
+        return cli
+
+    async def _agent_addr_of(self, node_id: NodeID) -> Optional[str]:
+        nodes = await self._ctl.call("list_nodes", {})
+        for n in nodes:
+            if n["node_id"] == node_id and n["alive"]:
+                return n["agent_addr"]
+        return None
+
+    async def _pg_agent_addr(self, pg_id, bundle_index) -> Optional[str]:
+        deadline = asyncio.get_event_loop().time() + 60.0
+        while asyncio.get_event_loop().time() < deadline:
+            info = await self._ctl.call("get_placement_group",
+                                        {"pg_id": pg_id})
+            if info is None:
+                return None
+            if info["state"] == "CREATED":
+                if bundle_index < 0:
+                    # Any bundle's node will do; pick the first.
+                    placement = info["placement"]
+                    if placement:
+                        return next(iter(placement.values()))["agent_addr"]
+                    return None
+                ent = info["placement"].get(bundle_index)
+                return ent["agent_addr"] if ent else None
+            if info["state"] == "REMOVED":
+                return None
+            await asyncio.sleep(0.05)
+        return None
+
+    def _fail_returns(self, spec: TaskSpec, err: TaskError) -> None:
+        for oid in spec.return_object_ids():
+            self._store_result_value(oid, err)
+
+    def _accept_returns(self, spec: TaskSpec, result: TaskResult) -> None:
+        from . import serialization
+
+        oids = spec.return_object_ids()
+        for oid, (kind, data) in zip(oids, result.returns):
+            if kind == "inline":
+                self._store_result_value(oid, serialization.unpack(data))
+            else:  # ("store", (size, node_hint))
+                size, node_hint = data
+                self._store_result_value(oid, _StoreRef(size, node_hint))
+
+    # ------------------------------------------------------------- actors
+    def create_actor(self, spec: TaskSpec) -> None:
+        r = self.io.run(self._ctl.call("register_actor", {
+            "spec": spec, "class_name": spec.name.split(".")[0],
+            "method_names": spec.method_names,
+            "detached": spec.lifetime == "detached",
+            "owner_addr": self._runtime_id}))
+        if not r.get("ok"):
+            raise ValueError(r.get("error", "actor registration failed"))
+        self.io.call_soon(lambda: self.io.loop.create_task(
+            self._create_actor_async(spec)))
+
+    async def _create_actor_async(self, spec: TaskSpec) -> None:
+        try:
+            await self._resolve_deps(spec)
+            payload = {
+                "resources": dict(spec.resources.amounts),
+                "strategy": spec.scheduling.kind,
+                "is_actor": True, "actor_id": spec.actor_id,
+            }
+            if spec.scheduling.kind == "PLACEMENT_GROUP":
+                payload["pg_id"] = spec.scheduling.placement_group_id
+                payload["bundle_index"] = spec.scheduling.bundle_index
+            agent_addr = self.agent_addr
+            if spec.scheduling.kind == "PLACEMENT_GROUP":
+                addr = await self._pg_agent_addr(payload["pg_id"],
+                                                 payload["bundle_index"])
+                if addr is not None:
+                    agent_addr = addr
+            elif spec.scheduling.kind == "NODE_AFFINITY" and \
+                    spec.scheduling.node_id is not None:
+                addr = await self._agent_addr_of(spec.scheduling.node_id)
+                if addr is not None:
+                    agent_addr = addr
+                    payload["no_spill"] = True
+            hops = 0
+            while True:
+                agent = await self._agent_for(agent_addr)
+                grant = await agent.call("request_lease", payload)
+                if grant.get("ok"):
+                    break
+                if grant.get("retry_at") and hops < 8:
+                    agent_addr = grant["retry_at"]
+                    hops += 1
+                    continue
+                raise ValueError(grant.get("error", "lease failed"))
+            worker = await self._worker_client(grant["worker_addr"])
+            r = await worker.call("create_actor", {
+                "spec": spec, "chip_ids": grant.get("chip_ids", []),
+                "lease_id": grant["lease_id"]})
+            if not r.get("ok"):
+                # Worker reported the creation error to the controller
+                # already; nothing else to do owner-side.
+                pass
+        except (RpcError, RemoteCallError, ValueError) as e:
+            try:
+                await self._ctl.call("actor_died", {
+                    "actor_id": spec.actor_id, "creation_failed": True,
+                    "reason": f"creation failed: {e}"})
+            except RpcError:
+                pass
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        oids = spec.return_object_ids()
+        self._mark_pending(oids)
+        self.io.call_soon(lambda: self.io.loop.create_task(
+            self._submit_actor(spec)))
+        return [ObjectRef(o) for o in oids]
+
+    async def _actor_info(self, actor_id: ActorID,
+                          wait_alive: bool = True,
+                          timeout: float = 120.0) -> Dict:
+        deadline = asyncio.get_event_loop().time() + timeout
+        delay = 0.02
+        while True:
+            info = self._actor_cache.get(actor_id)
+            if info is None or info["state"] not in ("ALIVE",) or \
+                    not info.get("worker_addr"):
+                info = await self._ctl.call("get_actor",
+                                            {"actor_id": actor_id})
+                if info is not None:
+                    self._actor_cache[actor_id] = info
+            if info is None:
+                raise ActorDiedError(actor_id.hex(), "unknown actor")
+            if info["state"] == "ALIVE" and info.get("worker_addr"):
+                return info
+            if info["state"] == "DEAD":
+                raise ActorDiedError(
+                    actor_id.hex(), info.get("death_reason") or "actor dead")
+            if not wait_alive or \
+                    asyncio.get_event_loop().time() > deadline:
+                raise ActorDiedError(actor_id.hex(),
+                                     f"actor stuck in {info['state']}")
+            await asyncio.sleep(delay)
+            delay = min(delay * 1.5, 0.5)
+
+    async def _submit_actor(self, spec: TaskSpec) -> None:
+        """Actor calls execute in submission order for max_concurrency=1
+        actors: the per-actor lock is taken in coroutine creation order
+        (FIFO) and held across dep resolution + push, so the worker's
+        single-threaded executor receives them in program order — and a
+        restarted actor needs no seq handshake (ref: the role of
+        ActorSubmitQueue in transport/actor_task_submitter.h, redesigned
+        around in-order connection delivery)."""
+        ordered = spec.max_concurrency <= 1
+        lock = self._actor_submit_locks.setdefault(
+            spec.actor_id, asyncio.Lock())
+        if ordered:
+            async with lock:
+                await self._submit_actor_inner(spec)
+        else:
+            await self._submit_actor_inner(spec)
+
+    async def _submit_actor_inner(self, spec: TaskSpec) -> None:
+        try:
+            await self._resolve_deps(spec)
+        except TaskError as e:
+            self._fail_returns(spec, e)
+            return
+        attempts_left = spec.max_retries
+        while True:
+            try:
+                info = await self._actor_info(spec.actor_id)
+            except ActorDiedError as e:
+                self._fail_returns(spec, ActorError.from_exception(e))
+                return
+            try:
+                worker = await self._worker_client(info["worker_addr"])
+                reply = await worker.call("push_actor_task", {
+                    "spec": spec, "caller_id": self._runtime_id})
+            except (RpcError, RemoteCallError) as e:
+                # Worker gone: refresh state; retry while restarting if the
+                # method has a retry budget, else surface death.
+                self._actor_cache.pop(spec.actor_id, None)
+                if isinstance(e, RemoteCallError):
+                    self._fail_returns(spec,
+                                       ActorError.from_exception(e.cause))
+                    return
+                if attempts_left != 0:
+                    if attempts_left > 0:
+                        attempts_left -= 1
+                    await asyncio.sleep(0.1)
+                    continue
+                try:
+                    await self._actor_info(spec.actor_id, timeout=5.0)
+                    reason = "actor task connection lost mid-call"
+                except ActorDiedError as de:
+                    reason = str(de.reason)
+                self._fail_returns(spec, ActorError.from_exception(
+                    ActorDiedError(spec.actor_id.hex(), reason)))
+                return
+            if not reply.ok:
+                err = reply.error
+                self._fail_returns(spec, err if isinstance(err, TaskError)
+                                   else ActorError.from_exception(err))
+                return
+            self._accept_returns(spec, reply)
+            return
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
+        self._actor_cache.pop(actor_id, None)
+        self.io.run(self._ctl.call("kill_actor", {
+            "actor_id": actor_id, "no_restart": no_restart}))
+
+    def get_named_actor(self, name: str, namespace: str = ""):
+        info = self.io.run(self._ctl.call("lookup_named_actor", {
+            "name": name, "namespace": namespace}))
+        if info is None or info["state"] == "DEAD":
+            raise ValueError(f"No actor named {name!r} in namespace "
+                             f"{namespace!r}")
+        from .api import ActorHandle
+
+        return ActorHandle(info["actor_id"], info["class_name"],
+                           info["method_names"], namespace,
+                           info.get("max_concurrency", 1))
+
+    # ------------------------------------------------------------- objects
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put(self.current_task_id(), self.next_put_index())
+        size = self.store.create_and_seal(oid, value)
+        self.io.run(self._agent.call("register_object",
+                                     {"object_id": oid, "size": size}))
+        self.memory.put(oid, _StoreRef(size))
+        return ObjectRef(oid)
+
+    def _notify_blocked(self, blocked: bool) -> None:
+        """Worker-role hook: release/reacquire lease CPU while blocked in
+        get (driver has no lease; no-op)."""
+        lease_id = self.current_lease_id
+        if lease_id is None:
+            return
+        method = "task_blocked" if blocked else "task_unblocked"
+        try:
+            self.io.run(self._agent.call(method, {"lease_id": lease_id}),
+                        timeout=5.0)
+        except Exception:
+            pass
+
+    def _fetch_store_value(self, oid: ObjectID,
+                           timeout: Optional[float]) -> Any:
+        """Pull a plane object into the local node store and map it."""
+        r = self.io.run(self._agent.call("pull_object", {
+            "object_id": oid,
+            "timeout": timeout if timeout is not None else 3600.0}))
+        if not r.get("ok"):
+            raise ObjectLostError(oid.hex())
+        return self.store.get(oid, r["size"])
+
+    def get(self, refs: List[ObjectRef],
+            timeout: Optional[float]) -> List[Any]:
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        # Figure out which refs need waiting; release CPU while blocked.
+        needs_wait = []
+        for r in refs:
+            ok, _ = self.memory.get_nowait(r.id)
+            if not ok:
+                with self._pending_lock:
+                    if r.id in self._pending_returns:
+                        needs_wait.append(r.id)
+        blocked = bool(needs_wait)
+        if blocked:
+            self._notify_blocked(True)
+        try:
+            out = []
+            for r in refs:
+                remaining = None
+                if deadline is not None:
+                    remaining = max(deadline - time.monotonic(), 0.0)
+                with self._pending_lock:
+                    pending = r.id in self._pending_returns
+                if pending or self.memory.contains(r.id):
+                    val = self.memory.wait_for(r.id, remaining)
+                else:
+                    val = self._fetch_store_value(r.id, remaining)
+                if isinstance(val, _StoreRef):
+                    val = self._fetch_store_value(r.id, remaining)
+                if isinstance(val, TaskError):
+                    raise val
+                out.append(val)
+            return out
+        finally:
+            if blocked:
+                self._notify_blocked(False)
+    # NOTE on _fetch_store_value for values we produced locally: the pull
+    # is satisfied by the local directory lookup, no copy happens.
+
+    def wait(self, refs: List[ObjectRef], num_returns: int,
+             timeout: Optional[float],
+             fetch_local: bool) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        ready: List[ObjectRef] = []
+        not_ready = list(refs)
+        while len(ready) < num_returns:
+            progressed = False
+            for r in list(not_ready):
+                if self._ready_nowait(r):
+                    ready.append(r)
+                    not_ready.remove(r)
+                    progressed = True
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            if not progressed:
+                time.sleep(0.005)
+        if fetch_local and ready:
+            try:
+                self.get(ready, timeout=None)
+            except TaskError:
+                pass  # errored objects still count as ready
+        return ready, not_ready
+
+    def _ready_nowait(self, ref: ObjectRef) -> bool:
+        ok, _ = self.memory.get_nowait(ref.id)
+        if ok:
+            return True
+        with self._pending_lock:
+            if ref.id in self._pending_returns:
+                return False
+        # Foreign ref: ask the local agent / directory.
+        try:
+            r = self.io.run(self._agent.call("object_exists",
+                                             {"object_id": ref.id}),
+                            timeout=5.0)
+            if r.get("exists"):
+                return True
+            loc = self.io.run(self._ctl.call("locate_object",
+                                             {"object_id": ref.id}),
+                              timeout=5.0)
+            return loc is not None and bool(loc["nodes"])
+        except Exception:
+            return False
+
+    def cancel(self, ref: ObjectRef, force: bool) -> None:
+        # Best-effort: queued-but-unleased tasks cannot be recalled yet.
+        # (Ref parity gap tracked for a later round: core_worker CancelTask.)
+        pass
+
+    # -------------------------------------------------------- introspection
+    def cluster_resources(self) -> Dict[str, float]:
+        nodes = self.io.run(self._ctl.call("list_nodes", {}))
+        total: Dict[str, float] = {}
+        for n in nodes:
+            if n["alive"]:
+                for k, v in n["resources"].items():
+                    total[k] = total.get(k, 0.0) + v
+        return total
+
+    def available_resources(self) -> Dict[str, float]:
+        nodes = self.io.run(self._ctl.call("list_nodes", {}))
+        total: Dict[str, float] = {}
+        for n in nodes:
+            if n["alive"]:
+                for k, v in n["available"].items():
+                    total[k] = total.get(k, 0.0) + v
+        return total
+
+    def nodes(self) -> List[Dict[str, Any]]:
+        out = []
+        for n in self.io.run(self._ctl.call("list_nodes", {})):
+            out.append({
+                "NodeID": n["node_id"].hex(), "Alive": n["alive"],
+                "Resources": n["resources"], "AgentAddress": n["agent_addr"],
+                "Labels": n["labels"], "IsHead": n.get("is_head", False)})
+        return out
+
+    def controller_call(self, method: str, payload=None, timeout=None):
+        """Escape hatch used by util/state/collective layers."""
+        return self.io.run(self._ctl.call(method, payload), timeout)
+
+    def agent_call(self, method: str, payload=None, timeout=None):
+        return self.io.run(self._agent.call(method, payload), timeout)
+
+    # ------------------------------------------------------------ shutdown
+    def shutdown(self) -> None:
+        self._shutdown_flag = True
+        try:
+            if self._owns_head:
+                try:
+                    self.io.run(self._ctl.call("cluster_shutdown", {}),
+                                timeout=5.0)
+                except Exception:
+                    pass
+        finally:
+            self.store.close()
+            self.memory.clear()
+            self.io.stop()
+            for p in self._procs:
+                try:
+                    p.wait(timeout=3.0)
+                except Exception:
+                    try:
+                        p.kill()
+                    except Exception:
+                        pass
+            if self._owns_head:
+                self._cleanup_shm()
+
+    def _cleanup_shm(self) -> None:
+        shm_dir = "/dev/shm"
+        prefix = f"rt_{self.session}_"
+        try:
+            for name in os.listdir(shm_dir):
+                if name.startswith(prefix):
+                    try:
+                        os.unlink(os.path.join(shm_dir, name))
+                    except OSError:
+                        pass
+        except OSError:
+            pass

@@ -1,0 +1,557 @@
+"""The controller process — cluster metadata authority.
+
+Role-equivalent to the reference's GCS server (ref:
+src/ray/gcs/gcs_server/gcs_server.h:89 and its manager classes): node
+membership + health checks, actor directory with restart orchestration,
+named actors, an object location directory, a KV store (collective
+rendezvous, function table), cursor-based pubsub, and job registration.
+Single asyncio process; all state lives on the loop thread so no locks.
+
+Deviation from the reference, on purpose: the object *location* directory
+is centralized here rather than owner-distributed — at TPU-host
+granularity the directory is small (hosts, not chips, hold objects) and a
+single authority removes the owner-failure protocol; lineage-based
+reconstruction still lives with the owning worker (task_manager.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .config import RuntimeConfig
+from .ids import ActorID, JobID, NodeID, ObjectID
+from .rpc import RpcClient, RpcError, RpcServer
+
+logger = logging.getLogger("ray_tpu.controller")
+
+# Actor lifecycle states (ref: gcs.proto ActorTableData.ActorState).
+PENDING = "PENDING"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+@dataclass
+class NodeEntry:
+    node_id: NodeID
+    agent_addr: str
+    resources_total: Dict[str, float]
+    resources_available: Dict[str, float]
+    last_heartbeat: float
+    alive: bool = True
+    labels: Dict[str, str] = field(default_factory=dict)
+    is_head: bool = False
+
+
+@dataclass
+class ActorEntry:
+    actor_id: ActorID
+    state: str
+    class_name: str
+    method_names: List[str]
+    node_id: Optional[NodeID] = None
+    worker_addr: str = ""
+    name: str = ""
+    namespace: str = ""
+    restarts_remaining: int = 0
+    creation_spec: Any = None          # pickled TaskSpec replayed on restart
+    owner_addr: str = ""
+    death_reason: str = ""
+    detached: bool = False
+    max_concurrency: int = 1
+
+
+class Controller:
+    def __init__(self, config: RuntimeConfig, session: str):
+        self.config = config
+        self.session = session
+        self.server = RpcServer()
+        self.nodes: Dict[NodeID, NodeEntry] = {}
+        self.actors: Dict[ActorID, ActorEntry] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.kv: Dict[str, bytes] = {}
+        self.object_dir: Dict[ObjectID, Dict] = {}  # oid -> {nodes:set,size}
+        self.events: Dict[str, List[Tuple[int, Any]]] = {}
+        self.event_seq = 0
+        self.event_waiters: List[asyncio.Event] = []
+        self.jobs: Dict[int, Dict] = {}
+        self.job_counter = 1
+        self._agent_clients: Dict[NodeID, RpcClient] = {}
+        self._placement = None  # PlacementGroupManager, attached in setup
+        self._shutdown = asyncio.Event()
+        for name in [
+            "register_node", "heartbeat", "list_nodes", "resource_view",
+            "register_actor", "actor_started", "actor_died", "get_actor",
+            "lookup_named_actor", "kill_actor", "worker_exited",
+            "kv_put", "kv_get", "kv_del", "kv_keys", "kv_append",
+            "publish_locations", "remove_locations", "locate_object",
+            "free_object", "poll_events", "register_job", "finish_job",
+            "create_placement_group", "remove_placement_group",
+            "get_placement_group", "list_placement_groups",
+            "list_actors", "cluster_shutdown", "ping", "drain_node",
+        ]:
+            self.server.register(name, getattr(self, name))
+
+    # ------------------------------------------------------------------ util
+    def _publish(self, channel: str, data: Any) -> None:
+        self.event_seq += 1
+        self.events.setdefault(channel, []).append((self.event_seq, data))
+        log = self.events[channel]
+        if len(log) > self.config.task_event_buffer_size:
+            del log[: len(log) // 2]
+        for ev in self.event_waiters:
+            ev.set()
+
+    async def _agent(self, node_id: NodeID) -> Optional[RpcClient]:
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            return None
+        cli = self._agent_clients.get(node_id)
+        if cli is None or not cli.connected:
+            cli = RpcClient(node.agent_addr, tag=f"controller->{node_id.hex()[:8]}")
+            try:
+                await cli.connect()
+            except RpcError:
+                return None
+            self._agent_clients[node_id] = cli
+        return cli
+
+    # ----------------------------------------------------------------- nodes
+    async def register_node(self, p):
+        node_id = p["node_id"]
+        entry = NodeEntry(
+            node_id=node_id, agent_addr=p["agent_addr"],
+            resources_total=p["resources"],
+            resources_available=dict(p["resources"]),
+            last_heartbeat=time.time(), labels=p.get("labels", {}),
+            is_head=p.get("is_head", False))
+        self.nodes[node_id] = entry
+        self._publish("node", {"node_id": node_id, "state": "ALIVE",
+                               "agent_addr": entry.agent_addr})
+        logger.info("node %s registered (%s)", node_id.hex()[:8],
+                    p["agent_addr"])
+        return {"ok": True, "session": self.session}
+
+    async def heartbeat(self, p):
+        node = self.nodes.get(p["node_id"])
+        if node is None:
+            return {"ok": False, "reregister": True}
+        node.last_heartbeat = time.time()
+        node.resources_available = p.get("available", node.resources_available)
+        if "total" in p:
+            node.resources_total = p["total"]
+        return {"ok": True}
+
+    async def list_nodes(self, _p):
+        return [
+            {"node_id": n.node_id, "agent_addr": n.agent_addr,
+             "alive": n.alive, "resources": n.resources_total,
+             "available": n.resources_available, "labels": n.labels,
+             "is_head": n.is_head}
+            for n in self.nodes.values()
+        ]
+
+    async def resource_view(self, _p):
+        """Scheduling snapshot used by agents for spillback decisions."""
+        return {
+            n.node_id: {"available": n.resources_available,
+                        "total": n.resources_total,
+                        "agent_addr": n.agent_addr}
+            for n in self.nodes.values() if n.alive
+        }
+
+    async def drain_node(self, p):
+        node = self.nodes.get(p["node_id"])
+        if node is None:
+            return {"ok": False}
+        cli = await self._agent(p["node_id"])
+        if cli is not None:
+            try:
+                await cli.call("drain", {})
+            except RpcError:
+                pass
+        return {"ok": True}
+
+    async def _health_loop(self) -> None:
+        period = self.config.raylet_heartbeat_period_ms / 1000.0
+        threshold = period * self.config.health_check_failure_threshold
+        while not self._shutdown.is_set():
+            await asyncio.sleep(period)
+            now = time.time()
+            for node in list(self.nodes.values()):
+                if node.alive and now - node.last_heartbeat > threshold:
+                    await self._mark_node_dead(node, "missed heartbeats")
+
+    async def _mark_node_dead(self, node: NodeEntry, reason: str) -> None:
+        node.alive = False
+        logger.warning("node %s dead: %s", node.node_id.hex()[:8], reason)
+        self._publish("node", {"node_id": node.node_id, "state": "DEAD"})
+        # Fail or restart every actor that lived there.
+        for actor in list(self.actors.values()):
+            if actor.node_id == node.node_id and actor.state in (ALIVE,
+                                                                 PENDING):
+                await self._handle_actor_failure(
+                    actor, f"node {node.node_id.hex()[:8]} died")
+        # Drop object locations on that node.
+        gone = []
+        for oid, info in self.object_dir.items():
+            info["nodes"].discard(node.node_id)
+            if not info["nodes"]:
+                gone.append(oid)
+        for oid in gone:
+            self._publish("object_lost", {"object_id": oid})
+        if self._placement is not None:
+            await self._placement.on_node_dead(node.node_id)
+
+    # ---------------------------------------------------------------- actors
+    async def register_actor(self, p):
+        """Called by the owner before scheduling the creation task."""
+        spec = p["spec"]
+        entry = ActorEntry(
+            actor_id=spec.actor_id, state=PENDING,
+            class_name=p["class_name"], method_names=p["method_names"],
+            name=spec.actor_name, namespace=spec.namespace,
+            restarts_remaining=spec.max_restarts,
+            creation_spec=spec, owner_addr=p.get("owner_addr", ""),
+            detached=p.get("detached", False),
+            max_concurrency=spec.max_concurrency)
+        key = (spec.namespace, spec.actor_name)
+        if spec.actor_name:
+            if key in self.named_actors:
+                return {"ok": False,
+                        "error": f"actor name {spec.actor_name!r} taken"}
+            self.named_actors[key] = spec.actor_id
+        self.actors[spec.actor_id] = entry
+        return {"ok": True}
+
+    async def actor_started(self, p):
+        actor = self.actors.get(p["actor_id"])
+        if actor is None:
+            return {"ok": False}
+        if actor.state == DEAD:
+            # Killed while still starting; tell the worker to exit.
+            return {"ok": False, "kill": True}
+        actor.state = ALIVE
+        actor.node_id = p["node_id"]
+        actor.worker_addr = p["worker_addr"]
+        self._publish("actor", {"actor_id": actor.actor_id, "state": ALIVE,
+                                "worker_addr": actor.worker_addr})
+        return {"ok": True}
+
+    async def actor_died(self, p):
+        """Agent-reported worker exit for an actor (crash or kill)."""
+        actor = self.actors.get(p["actor_id"])
+        if actor is None:
+            return {"ok": False}
+        if p.get("creation_failed"):
+            actor.restarts_remaining = 0
+        await self._handle_actor_failure(
+            actor, p.get("reason", "worker exited"),
+            no_restart=p.get("no_restart", False))
+        return {"ok": True}
+
+    async def _handle_actor_failure(self, actor: ActorEntry, reason: str,
+                                    no_restart: bool = False) -> None:
+        if actor.state == DEAD:
+            return
+        if not no_restart and actor.restarts_remaining != 0:
+            if actor.restarts_remaining > 0:
+                actor.restarts_remaining -= 1
+            actor.state = RESTARTING
+            actor.worker_addr = ""
+            self._publish("actor", {"actor_id": actor.actor_id,
+                                    "state": RESTARTING})
+            asyncio.ensure_future(self._restart_actor(actor))
+        else:
+            actor.state = DEAD
+            actor.death_reason = reason
+            actor.worker_addr = ""
+            if actor.name:
+                self.named_actors.pop((actor.namespace, actor.name), None)
+            self._publish("actor", {"actor_id": actor.actor_id,
+                                    "state": DEAD, "reason": reason})
+
+    async def _restart_actor(self, actor: ActorEntry) -> None:
+        """Re-run the creation spec on a live node (ref:
+        gcs_actor_manager.h:553 restart flow)."""
+        delay = self.config.task_retry_delay_ms / 1000.0
+        for _attempt in range(60):
+            await asyncio.sleep(delay)
+            for node in self.nodes.values():
+                if not node.alive:
+                    continue
+                cli = await self._agent(node.node_id)
+                if cli is None:
+                    continue
+                try:
+                    r = await cli.call("restart_actor",
+                                       {"spec": actor.creation_spec})
+                    if r.get("ok"):
+                        return  # agent will report actor_started
+                except RpcError:
+                    continue
+            delay = min(delay * 2, 2.0)
+        await self._handle_actor_failure(actor, "restart failed",
+                                         no_restart=True)
+
+    async def get_actor(self, p):
+        actor = self.actors.get(p["actor_id"])
+        if actor is None:
+            return None
+        return {"actor_id": actor.actor_id, "state": actor.state,
+                "worker_addr": actor.worker_addr,
+                "class_name": actor.class_name,
+                "method_names": actor.method_names,
+                "death_reason": actor.death_reason,
+                "max_concurrency": actor.max_concurrency}
+
+    async def list_actors(self, _p):
+        return [
+            {"actor_id": a.actor_id, "state": a.state,
+             "class_name": a.class_name, "name": a.name,
+             "node_id": a.node_id, "worker_addr": a.worker_addr}
+            for a in self.actors.values()
+        ]
+
+    async def lookup_named_actor(self, p):
+        aid = self.named_actors.get((p.get("namespace", ""), p["name"]))
+        if aid is None:
+            return None
+        return await self.get_actor({"actor_id": aid})
+
+    async def kill_actor(self, p):
+        actor = self.actors.get(p["actor_id"])
+        if actor is None:
+            return {"ok": False}
+        actor.restarts_remaining = 0 if p.get("no_restart", True) else \
+            actor.restarts_remaining
+        if actor.node_id is not None:
+            cli = await self._agent(actor.node_id)
+            if cli is not None:
+                try:
+                    await cli.call("kill_worker",
+                                   {"actor_id": actor.actor_id})
+                except RpcError:
+                    pass
+        await self._handle_actor_failure(actor, "killed via kill()",
+                                         no_restart=p.get("no_restart", True))
+        return {"ok": True}
+
+    async def worker_exited(self, p):
+        """Generic notification; actor workers route through actor_died."""
+        return {"ok": True}
+
+    # -------------------------------------------------------------------- kv
+    async def kv_put(self, p):
+        overwrite = p.get("overwrite", True)
+        if not overwrite and p["key"] in self.kv:
+            return {"ok": False, "exists": True}
+        self.kv[p["key"]] = p["value"]
+        self._publish("kv", {"key": p["key"]})
+        return {"ok": True}
+
+    async def kv_get(self, p):
+        return self.kv.get(p["key"])
+
+    async def kv_del(self, p):
+        self.kv.pop(p["key"], None)
+        return {"ok": True}
+
+    async def kv_keys(self, p):
+        prefix = p.get("prefix", "")
+        return [k for k in self.kv if k.startswith(prefix)]
+
+    async def kv_append(self, p):
+        """Atomic append to a list value — rendezvous building block."""
+        cur = self.kv.get(p["key"], b"")
+        items = cur.split(b"\x00") if cur else []
+        items.append(p["value"])
+        self.kv[p["key"]] = b"\x00".join(items)
+        self._publish("kv", {"key": p["key"]})
+        return {"count": len(items)}
+
+    # -------------------------------------------------------- object plane
+    async def publish_locations(self, p):
+        node_id = p["node_id"]
+        for oid, size in p["objects"]:
+            info = self.object_dir.get(oid)
+            if info is None:
+                info = self.object_dir[oid] = {"nodes": set(), "size": size}
+            info["nodes"].add(node_id)
+            info["size"] = size
+        return {"ok": True}
+
+    async def remove_locations(self, p):
+        node_id = p["node_id"]
+        for oid in p["objects"]:
+            info = self.object_dir.get(oid)
+            if info is not None:
+                info["nodes"].discard(node_id)
+                if not info["nodes"]:
+                    del self.object_dir[oid]
+        return {"ok": True}
+
+    async def locate_object(self, p):
+        info = self.object_dir.get(p["object_id"])
+        if info is None:
+            return None
+        nodes = []
+        for nid in info["nodes"]:
+            node = self.nodes.get(nid)
+            if node is not None and node.alive:
+                nodes.append({"node_id": nid, "agent_addr": node.agent_addr})
+        return {"nodes": nodes, "size": info["size"]}
+
+    async def free_object(self, p):
+        oid = p["object_id"]
+        info = self.object_dir.pop(oid, None)
+        if info is None:
+            return {"ok": True}
+        for nid in list(info["nodes"]):
+            cli = await self._agent(nid)
+            if cli is not None:
+                try:
+                    await cli.notify("delete_object", {"object_id": oid})
+                except RpcError:
+                    pass
+        return {"ok": True}
+
+    # ---------------------------------------------------------------- pubsub
+    async def poll_events(self, p):
+        """Cursor-based long-poll (ref: src/ray/pubsub long-poll design)."""
+        cursor = p.get("cursor", 0)
+        channels = p.get("channels", ["actor", "node"])
+        timeout = p.get("timeout", 30.0)
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            out = []
+            for ch in channels:
+                for seq, data in self.events.get(ch, []):
+                    if seq > cursor:
+                        out.append((seq, ch, data))
+            if out:
+                out.sort()
+                return {"events": out, "cursor": out[-1][0]}
+            remaining = deadline - asyncio.get_event_loop().time()
+            if remaining <= 0:
+                return {"events": [], "cursor": cursor}
+            ev = asyncio.Event()
+            self.event_waiters.append(ev)
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                self.event_waiters.remove(ev)
+
+    # ------------------------------------------------------------------ jobs
+    async def register_job(self, p):
+        jid = self.job_counter
+        self.job_counter += 1
+        self.jobs[jid] = {"start": time.time(), "driver": p.get("driver", ""),
+                          "alive": True}
+        return {"job_id": jid}
+
+    async def finish_job(self, p):
+        job = self.jobs.get(p["job_id"])
+        if job:
+            job["alive"] = False
+        return {"ok": True}
+
+    # ------------------------------------------------------ placement groups
+    async def create_placement_group(self, p):
+        return await self._placement.create(p)
+
+    async def remove_placement_group(self, p):
+        return await self._placement.remove(p)
+
+    async def get_placement_group(self, p):
+        return self._placement.get(p)
+
+    async def list_placement_groups(self, p):
+        return self._placement.list_all(p)
+
+    # -------------------------------------------------------------- lifetime
+    async def ping(self, _p):
+        return {"ok": True, "session": self.session,
+                "time": time.time()}
+
+    async def cluster_shutdown(self, _p):
+        for node in self.nodes.values():
+            cli = await self._agent(node.node_id)
+            if cli is not None:
+                try:
+                    await cli.notify("shutdown", {})
+                except RpcError:
+                    pass
+        asyncio.get_event_loop().call_later(0.2, self._shutdown.set)
+        return {"ok": True}
+
+    async def run(self, port: int = 0, driver_pid: int = 0) -> int:
+        from .placement import PlacementGroupManager
+
+        self._placement = PlacementGroupManager(self)
+        await self.server.start(port)
+        asyncio.ensure_future(self._health_loop())
+        if driver_pid:
+            asyncio.ensure_future(self._watch_driver(driver_pid))
+        return self.server.port
+
+    async def _watch_driver(self, pid: int) -> None:
+        """Head clusters spawned by a driver die with it (atexit handles
+        clean exits; this covers SIGKILL so nothing orphans a 1-core
+        host).  Clusters started standalone pass no pid and outlive
+        drivers the way the reference's do."""
+        while not self._shutdown.is_set():
+            await asyncio.sleep(2.0)
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                logger.warning("owning driver %d is gone; shutting down",
+                               pid)
+                await self.cluster_shutdown(None)
+                return
+
+    async def wait_shutdown(self) -> None:
+        await self._shutdown.wait()
+        await self.server.stop()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--session", required=True)
+    parser.add_argument("--ready-fd", type=int, default=-1)
+    parser.add_argument("--driver-pid", type=int, default=0)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    config = RuntimeConfig.from_env()
+
+    async def _run():
+        ctl = Controller(config, args.session)
+        port = await ctl.run(args.port, driver_pid=args.driver_pid)
+        if args.ready_fd >= 0:
+            os.write(args.ready_fd, f"{port}\n".encode())
+            os.close(args.ready_fd)
+        else:
+            print(f"CONTROLLER_PORT={port}", flush=True)
+        await ctl.wait_shutdown()
+
+    try:
+        asyncio.run(_run())
+    except KeyboardInterrupt:
+        pass
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

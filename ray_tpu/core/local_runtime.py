@@ -118,6 +118,11 @@ class LocalRuntime(BaseRuntime):
             return self._store_error(spec, TaskError.from_exception(e))
 
     def create_actor(self, spec: TaskSpec) -> None:
+        # Name conflicts must fail BEFORE running the user's __init__ —
+        # otherwise the loser leaks a live duplicate instance.
+        if spec.actor_name and (spec.namespace,
+                                spec.actor_name) in self._named:
+            raise ValueError(f"Actor name {spec.actor_name!r} already taken")
         cls = self._load_func(spec)
         try:
             pos, kwargs = self._resolve_args(spec)
@@ -132,9 +137,6 @@ class LocalRuntime(BaseRuntime):
         self._actors[spec.actor_id] = slot
         if spec.actor_name:
             key = (spec.namespace, spec.actor_name)
-            if key in self._named:
-                raise ValueError(
-                    f"Actor name {spec.actor_name!r} already taken")
             slot.registered_name = key
             from .api import ActorHandle
 
@@ -142,7 +144,7 @@ class LocalRuntime(BaseRuntime):
                 spec.actor_id, slot.class_name,
                 [n for n in dir(instance)
                  if not n.startswith("_") and callable(getattr(instance, n))],
-                spec.namespace)
+                spec.namespace, spec.max_concurrency)
             self._named[key] = handle
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:

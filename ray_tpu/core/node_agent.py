@@ -1,0 +1,784 @@
+"""The node agent — per-node scheduler, worker pool, and object plane.
+
+Role-equivalent to the reference's raylet (ref: src/ray/raylet/
+node_manager.h:117 NodeManager, worker_pool.h:216 WorkerPool,
+scheduling/cluster_task_manager.h + local_task_manager.h).  One agent per
+host: grants worker leases against a resource ledger (hybrid
+local-first/spillback policy), spawns and supervises worker processes,
+owns the shared-memory store directory, serves node-to-node object
+transfer, and holds placement-group bundle reservations (two-phase
+prepare/commit, ref: gcs_placement_group_scheduler.h).
+
+TPU note: the agent also owns the host's chip ledger — a lease that
+demands ``TPU: k`` is granted k specific chip ids which the worker maps to
+``TPU_VISIBLE_CHIPS`` before initializing jax, the TPU analogue of the
+reference's CUDA_VISIBLE_DEVICES isolation
+(ref: python/ray/_private/accelerators/tpu.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .config import RuntimeConfig
+from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, WorkerID
+from .object_store import SharedObjectStore, StoreDirectory
+from .resources import ResourceSet, node_resources
+from .rpc import RpcClient, RpcError, RpcServer
+
+logger = logging.getLogger("ray_tpu.node_agent")
+
+
+@dataclass
+class WorkerEntry:
+    worker_id: WorkerID
+    addr: str
+    pid: int
+    proc: Optional[subprocess.Popen] = None
+    state: str = "idle"  # starting | idle | leased | actor | dead
+    actor_id: Optional[ActorID] = None
+    lease_id: Optional[int] = None
+
+
+@dataclass
+class Lease:
+    lease_id: int
+    resources: ResourceSet
+    worker: WorkerEntry
+    chip_ids: List[int]
+    pg_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    blocked: bool = False
+
+
+@dataclass
+class _PendingLease:
+    payload: Dict[str, Any]
+    future: asyncio.Future
+    enqueue_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class _Bundle:
+    pg_id: PlacementGroupID
+    bundle_index: int
+    resources: ResourceSet
+    committed: bool = False
+    in_use: ResourceSet = field(default_factory=ResourceSet)
+
+
+class NodeAgent:
+    def __init__(self, config: RuntimeConfig, session: str,
+                 controller_addr: str, *,
+                 num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 custom_resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 is_head: bool = False):
+        self.config = config
+        self.session = session
+        self.controller_addr = controller_addr
+        self.node_id = NodeID.from_random()
+        self.is_head = is_head
+        self.labels = labels or {}
+        self.total = node_resources(
+            num_cpus=num_cpus, num_tpus=num_tpus, extra=custom_resources,
+            tpu_override_chips=config.tpu_chips_per_host)
+        self.available = self.total.copy()
+        n_chips = int(self.total.get("TPU"))
+        self.free_chips: List[int] = list(range(n_chips))
+        self.server = RpcServer()
+        self.store = SharedObjectStore(session)
+        self.directory = StoreDirectory(
+            self.store, config.object_store_memory_bytes)
+        self.workers: Dict[WorkerID, WorkerEntry] = {}
+        self.leases: Dict[int, Lease] = {}
+        self.bundles: Dict[Tuple[PlacementGroupID, int], _Bundle] = {}
+        self.pending: List[_PendingLease] = []
+        self._lease_counter = itertools.count(1)
+        self._starting_workers = 0
+        self._idle_q: List[WorkerEntry] = []
+        self._worker_ready = asyncio.Event()
+        self._pull_inflight: Dict[ObjectID, asyncio.Future] = {}
+        self._ctl: Optional[RpcClient] = None
+        self._peer_agents: Dict[str, RpcClient] = {}
+        self._resource_view: Dict[Any, Dict] = {}
+        self._draining = False
+        self._shutdown = asyncio.Event()
+        self._spawned_procs: List[subprocess.Popen] = []
+        for name in [
+            "request_lease", "return_lease", "lease_status",
+            "register_worker", "worker_heartbeat",
+            "task_blocked", "task_unblocked",
+            "register_object", "pull_object", "fetch_raw", "delete_object",
+            "object_exists", "store_stats",
+            "prepare_bundle", "commit_bundle", "return_bundle",
+            "restart_actor", "kill_worker", "report_actor_failure",
+            "drain", "shutdown", "ping", "node_info",
+        ]:
+            self.server.register(name, getattr(self, name))
+
+    # -------------------------------------------------------------- startup
+    async def start(self, port: int = 0) -> int:
+        await self.server.start(port)
+        self._ctl = RpcClient(self.controller_addr,
+                              tag=f"agent-{self.node_id.hex()[:8]}",
+                              connect_timeout=5.0)
+        await self._ctl.connect()
+        await self._ctl.call("register_node", {
+            "node_id": self.node_id, "agent_addr": self.server.address,
+            "resources": dict(self.total.amounts), "labels": self.labels,
+            "is_head": self.is_head})
+        asyncio.ensure_future(self._heartbeat_loop())
+        asyncio.ensure_future(self._reap_loop())
+        for _ in range(self.config.worker_pool_min_workers):
+            self._spawn_worker()
+        return self.server.port
+
+    async def _heartbeat_loop(self) -> None:
+        period = self.config.raylet_heartbeat_period_ms / 1000.0
+        misses = 0
+        while not self._shutdown.is_set():
+            try:
+                r = await self._ctl.call("heartbeat", {
+                    "node_id": self.node_id,
+                    "available": {k: max(v, 0.0) for k, v in
+                                  self.available.amounts.items()},
+                    "total": dict(self.total.amounts)})
+                if r.get("reregister"):
+                    await self._ctl.call("register_node", {
+                        "node_id": self.node_id,
+                        "agent_addr": self.server.address,
+                        "resources": dict(self.total.amounts),
+                        "labels": self.labels, "is_head": self.is_head})
+                misses = 0
+            except RpcError:
+                misses += 1
+                if misses >= 3:
+                    # Controller is gone: this node has no cluster; exit
+                    # and take workers down (no orphan process trees).
+                    logger.warning("controller unreachable; shutting down")
+                    await self.shutdown()
+                    return
+            await asyncio.sleep(period)
+
+    async def _reap_loop(self) -> None:
+        """Detect worker process exits (ref: worker_pool.cc monitoring)."""
+        while not self._shutdown.is_set():
+            await asyncio.sleep(0.1)
+            for w in list(self.workers.values()):
+                if w.proc is not None and w.proc.poll() is not None \
+                        and w.state != "dead":
+                    await self._on_worker_exit(w)
+            # Workers that died before registering.
+            pending = getattr(self, "_pending_spawns", {})
+            for pid, proc in list(pending.items()):
+                if proc.poll() is not None:
+                    pending.pop(pid, None)
+                    self._starting_workers = max(
+                        0, self._starting_workers - 1)
+                    self._worker_ready.set()
+                    logger.warning("worker pid %s died before registering "
+                                   "(code %s)", pid, proc.returncode)
+
+    async def _on_worker_exit(self, w: WorkerEntry) -> None:
+        prev_state = w.state
+        w.state = "dead"
+        self.workers.pop(w.worker_id, None)
+        if w in self._idle_q:
+            self._idle_q.remove(w)
+        if w.lease_id is not None and w.lease_id in self.leases:
+            self._release_lease(self.leases[w.lease_id], worker_back=False)
+        if prev_state == "actor" and w.actor_id is not None:
+            code = w.proc.returncode if w.proc else None
+            try:
+                await self._ctl.call("actor_died", {
+                    "actor_id": w.actor_id,
+                    "reason": f"worker exited with code {code}"})
+            except RpcError:
+                pass
+        logger.info("worker %s exited (state=%s)", w.pid, prev_state)
+
+    # --------------------------------------------------------- worker pool
+    def _spawn_worker(self) -> None:
+        env = dict(os.environ)
+        env.update(self.config.env_overrides())
+        env.update({
+            "RT_SESSION_NAME": self.session,
+            "RT_CONTROLLER_ADDR": self.controller_addr,
+            "RT_AGENT_ADDR": self.server.address,
+            "RT_NODE_ID": self.node_id.hex(),
+        })
+        log_dir = os.path.join(self.config.session_dir_root, self.session,
+                               "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        self._starting_workers += 1
+        out = open(os.path.join(
+            log_dir, f"worker-{self.node_id.hex()[:8]}-"
+            f"{self._starting_workers}-{time.time():.0f}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-m", "ray_tpu.core.worker_main"],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        out.close()
+        self._spawned_procs.append(proc)
+        self._pending_spawns = getattr(self, "_pending_spawns", {})
+        self._pending_spawns[proc.pid] = proc
+
+    async def register_worker(self, p):
+        w = WorkerEntry(
+            worker_id=p["worker_id"], addr=p["addr"], pid=p["pid"],
+            proc=getattr(self, "_pending_spawns", {}).pop(p["pid"], None),
+            state="idle")
+        self.workers[w.worker_id] = w
+        self._starting_workers = max(0, self._starting_workers - 1)
+        self._idle_q.append(w)
+        self._worker_ready.set()
+        self._kick_scheduler()
+        return {"ok": True, "node_id": self.node_id}
+
+    async def worker_heartbeat(self, p):
+        return {"ok": True}
+
+    def _max_workers(self) -> int:
+        cap = self.config.worker_pool_max_workers
+        if cap > 0:
+            return cap
+        return max(int(self.total.get("CPU")) * 4, 16)
+
+    async def _acquire_worker(self) -> Optional[WorkerEntry]:
+        # Spawns are bounded by live demand (waiting acquirers), not by the
+        # wake-up rate — otherwise every near-miss wake-up forks another
+        # interpreter and a 1-core host death-spirals.
+        self._num_acquirers = getattr(self, "_num_acquirers", 0) + 1
+        deadline = asyncio.get_event_loop().time() + \
+            self.config.worker_start_timeout_s
+        try:
+            while True:
+                if self._idle_q:
+                    w = self._idle_q.pop(0)
+                    if w.state == "idle":
+                        return w
+                    continue
+                active = len(self.workers) + self._starting_workers
+                if self._starting_workers < self._num_acquirers and \
+                        active < self._max_workers():
+                    self._spawn_worker()
+                self._worker_ready.clear()
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    return None
+                try:
+                    await asyncio.wait_for(self._worker_ready.wait(),
+                                           remaining)
+                except asyncio.TimeoutError:
+                    return None
+        finally:
+            self._num_acquirers -= 1
+
+    # ----------------------------------------------------------- scheduling
+    def _kick_scheduler(self) -> None:
+        asyncio.ensure_future(self._drain_pending())
+
+    async def _drain_pending(self) -> None:
+        # FIFO with head-of-line skip for infeasible-now requests.
+        still: List[_PendingLease] = []
+        pending, self.pending = self.pending, []
+        for req in pending:
+            if req.future.done():
+                continue
+            granted = await self._try_grant(req.payload)
+            if granted is None:
+                still.append(req)
+            else:
+                req.future.set_result(granted)
+        self.pending.extend(still)
+
+    def _bundle_for(self, payload) -> Optional[_Bundle]:
+        pg_id = payload.get("pg_id")
+        if pg_id is None:
+            return None
+        idx = payload.get("bundle_index", -1)
+        if idx >= 0:
+            return self.bundles.get((pg_id, idx))
+        for (bpid, _bidx), b in self.bundles.items():
+            if bpid == pg_id and b.committed and \
+                    b.resources.subtract(b.in_use).covers(
+                        ResourceSet(payload["resources"])):
+                return b
+        return None
+
+    async def _try_grant(self, payload) -> Optional[Dict]:
+        # Reserve resources synchronously (no awaits) so concurrent grant
+        # attempts can't double-spend, then await a worker and refund on
+        # failure.
+        demand = ResourceSet(dict(payload["resources"]))
+        bundle = self._bundle_for(payload)
+        if payload.get("pg_id") is not None:
+            if bundle is None or not bundle.committed:
+                return None  # bundle not ready yet; stay queued
+            if not bundle.resources.subtract(bundle.in_use).covers(demand):
+                return None
+            bundle.in_use = bundle.in_use.add(demand)
+        elif not self.available.covers(demand):
+            return None
+        else:
+            self.available = self.available.subtract(demand)
+        chip_ids: List[int] = []
+        n_tpu = int(demand.get("TPU"))
+        if n_tpu > 0 and payload.get("pg_id") is None:
+            chip_ids = self.free_chips[:n_tpu]
+            self.free_chips = self.free_chips[n_tpu:]
+        w = await self._acquire_worker()
+        if w is None:
+            if bundle is not None:
+                bundle.in_use = bundle.in_use.subtract(demand)
+            else:
+                self.available = self.available.add(demand)
+                self._clamp_available()
+            self.free_chips.extend(chip_ids)
+            return None
+        lease = Lease(
+            lease_id=next(self._lease_counter), resources=demand, worker=w,
+            chip_ids=chip_ids, pg_id=payload.get("pg_id"),
+            bundle_index=payload.get("bundle_index", -1))
+        w.state = "actor" if payload.get("is_actor") else "leased"
+        w.lease_id = lease.lease_id
+        if payload.get("actor_id") is not None:
+            w.actor_id = payload["actor_id"]
+        self.leases[lease.lease_id] = lease
+        return {"ok": True, "lease_id": lease.lease_id,
+                "worker_addr": w.addr, "worker_id": w.worker_id,
+                "chip_ids": chip_ids, "node_id": self.node_id}
+
+    async def request_lease(self, p):
+        """Grant a worker lease, queue, or spill to another node (ref:
+        node_manager.cc:1867 HandleRequestWorkerLease +
+        hybrid_scheduling_policy.h)."""
+        if self._draining:
+            return {"ok": False, "error": "node draining"}
+        granted = await self._try_grant(p)
+        if granted is not None:
+            return granted
+        demand = ResourceSet(dict(p["resources"]))
+        # Spillback decision (not for PG-bound or affinity-bound leases).
+        strategy = p.get("strategy", "DEFAULT")
+        if p.get("pg_id") is None and not p.get("no_spill") \
+                and strategy in ("DEFAULT", "SPREAD"):
+            target = await self._pick_remote(demand, strategy)
+            if target is not None:
+                return {"ok": False, "retry_at": target}
+        if not self.total.covers(demand) and p.get("pg_id") is None:
+            return {"ok": False,
+                    "infeasible": True,
+                    "error": f"resources {demand.amounts} can never be "
+                             f"satisfied by node {self.node_id.hex()[:8]} "
+                             f"(total {self.total.amounts})"}
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self.pending.append(_PendingLease(p, fut))
+        timeout = p.get("queue_timeout") or 3600.0
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return {"ok": False, "error": "lease queue timeout"}
+
+    async def _pick_remote(self, demand: ResourceSet,
+                           strategy: str) -> Optional[str]:
+        """Hybrid policy: stay local under the utilization threshold, else
+        pick the best remote with available capacity (ref:
+        policy/hybrid_scheduling_policy.h:29-50)."""
+        local_util = self.available.utilization(self.total)
+        if strategy == "DEFAULT" and \
+                local_util < self.config.scheduler_spread_threshold \
+                and self.total.covers(demand):
+            return None  # queue locally; we're not saturated
+        try:
+            view = await self._ctl.call("resource_view", {})
+        except RpcError:
+            return None
+        candidates = []
+        for nid, info in view.items():
+            if nid == self.node_id:
+                continue
+            avail = ResourceSet(dict(info["available"]))
+            total = ResourceSet(dict(info["total"]))
+            if avail.covers(demand):
+                candidates.append((avail.utilization(total), str(nid.hex()),
+                                   info["agent_addr"]))
+        if not candidates:
+            return None
+        candidates.sort()
+        if strategy == "SPREAD":
+            return candidates[0][2]
+        # DEFAULT: only spill if we cannot serve now and someone can.
+        if not self.available.covers(demand):
+            return candidates[0][2]
+        return None
+
+    def _release_lease(self, lease: Lease, worker_back: bool = True) -> None:
+        if lease.lease_id not in self.leases:
+            return
+        del self.leases[lease.lease_id]
+        bundle = None
+        if lease.pg_id is not None:
+            bundle = self.bundles.get((lease.pg_id, lease.bundle_index))
+            if bundle is None:
+                for key, b in self.bundles.items():
+                    if key[0] == lease.pg_id and \
+                            b.in_use.covers(lease.resources):
+                        bundle = b
+                        break
+        if bundle is not None:
+            try:
+                bundle.in_use = bundle.in_use.subtract(lease.resources)
+            except ValueError:
+                bundle.in_use = ResourceSet()
+        elif not lease.blocked:
+            self.available = self.available.add(lease.resources)
+            self._clamp_available()
+        self.free_chips.extend(lease.chip_ids)
+        w = lease.worker
+        w.lease_id = None
+        if worker_back and w.state == "leased":
+            w.state = "idle"
+            w.actor_id = None
+            self._idle_q.append(w)
+            self._worker_ready.set()
+        self._kick_scheduler()
+
+    def _clamp_available(self) -> None:
+        for k, cap in self.total.amounts.items():
+            if self.available.amounts.get(k, 0.0) > cap:
+                self.available.amounts[k] = cap
+
+    async def return_lease(self, p):
+        lease = self.leases.get(p["lease_id"])
+        if lease is not None:
+            self._release_lease(lease)
+        return {"ok": True}
+
+    async def lease_status(self, p):
+        lease = self.leases.get(p["lease_id"])
+        if lease is None:
+            return {"alive": False}
+        return {"alive": lease.worker.state != "dead",
+                "worker_addr": lease.worker.addr}
+
+    # -------------------------------------------- blocked-worker CPU credit
+    async def task_blocked(self, p):
+        """A worker blocked in get(): return its CPU so nested tasks can
+        schedule (ref: the reference releases CPU for blocked workers in
+        local_task_manager)."""
+        lease = self.leases.get(p["lease_id"])
+        if lease is not None and not lease.blocked:
+            lease.blocked = True
+            if lease.pg_id is None:
+                self.available = self.available.add(lease.resources)
+                self._clamp_available()
+            self._kick_scheduler()
+        return {"ok": True}
+
+    async def task_unblocked(self, p):
+        lease = self.leases.get(p["lease_id"])
+        if lease is not None and lease.blocked:
+            lease.blocked = False
+            if lease.pg_id is None:
+                # May oversubscribe briefly; clamped in heartbeat view.
+                try:
+                    self.available = self.available.subtract(lease.resources)
+                except ValueError:
+                    self.available = ResourceSet({
+                        k: self.available.get(k) - v
+                        for k, v in lease.resources.amounts.items()
+                        if True})
+        return {"ok": True}
+
+    # -------------------------------------------------------- object plane
+    async def register_object(self, p):
+        oid, size = p["object_id"], p["size"]
+        evicted = self.directory.register(oid, size)
+        try:
+            await self._ctl.call("publish_locations", {
+                "node_id": self.node_id, "objects": [(oid, size)]})
+            if evicted:
+                await self._ctl.call("remove_locations", {
+                    "node_id": self.node_id, "objects": evicted})
+        except RpcError:
+            pass
+        return {"ok": True}
+
+    async def object_exists(self, p):
+        ent = self.directory.lookup(p["object_id"])
+        return {"exists": ent is not None,
+                "size": ent.size if ent else 0}
+
+    async def pull_object(self, p):
+        """Ensure the object is in the local store; returns its size.
+        (ref: pull_manager.h:52 — location lookup then chunked fetch.)"""
+        oid = p["object_id"]
+        ent = self.directory.lookup(oid)
+        if ent is not None:
+            return {"ok": True, "size": ent.size}
+        inflight = self._pull_inflight.get(oid)
+        if inflight is not None:
+            return await asyncio.shield(inflight)
+        fut = asyncio.get_event_loop().create_future()
+        self._pull_inflight[oid] = fut
+        try:
+            result = await self._do_pull(oid, p.get("timeout", 30.0))
+            if not fut.done():
+                fut.set_result(result)
+            return result
+        except Exception as e:
+            if not fut.done():
+                fut.set_exception(e)
+            raise
+        finally:
+            self._pull_inflight.pop(oid, None)
+
+    async def _do_pull(self, oid: ObjectID, timeout: float) -> Dict:
+        deadline = asyncio.get_event_loop().time() + timeout
+        delay = 0.02
+        while True:
+            try:
+                loc = await self._ctl.call("locate_object",
+                                           {"object_id": oid})
+            except RpcError:
+                loc = None
+            if loc and loc["nodes"]:
+                for cand in loc["nodes"]:
+                    if cand["node_id"] == self.node_id:
+                        continue
+                    addr = cand["agent_addr"]
+                    cli = self._peer_agents.get(addr)
+                    if cli is None or not cli.connected:
+                        cli = RpcClient(addr, tag=f"agent-pull-{self.node_id.hex()[:6]}")
+                        try:
+                            await cli.connect()
+                        except RpcError:
+                            continue
+                        self._peer_agents[addr] = cli
+                    try:
+                        data = await cli.call("fetch_raw",
+                                              {"object_id": oid})
+                    except RpcError:
+                        continue
+                    if data is None:
+                        continue
+                    self.store.put_raw(oid, data)
+                    self.directory.register(oid, len(data))
+                    try:
+                        await self._ctl.call("publish_locations", {
+                            "node_id": self.node_id,
+                            "objects": [(oid, len(data))]})
+                    except RpcError:
+                        pass
+                    return {"ok": True, "size": len(data)}
+            # Re-check local (producer may have just sealed here).
+            ent = self.directory.lookup(oid)
+            if ent is not None:
+                return {"ok": True, "size": ent.size}
+            if asyncio.get_event_loop().time() > deadline:
+                return {"ok": False, "error": "object not found"}
+            await asyncio.sleep(delay)
+            delay = min(delay * 1.5, 0.5)
+
+    async def fetch_raw(self, p):
+        ent = self.directory.lookup(p["object_id"])
+        if ent is None:
+            return None
+        try:
+            return self.store.read_raw(p["object_id"], ent.size)
+        except FileNotFoundError:
+            return None
+
+    async def delete_object(self, p):
+        self.directory.delete(p["object_id"])
+
+    async def store_stats(self, _p):
+        n, used, cap = self.directory.stats()
+        return {"objects": n, "used_bytes": used, "capacity_bytes": cap}
+
+    # -------------------------------------------------- placement bundles
+    async def prepare_bundle(self, p):
+        demand = ResourceSet(dict(p["resources"]))
+        if not self.available.covers(demand):
+            return {"ok": False}
+        self.available = self.available.subtract(demand)
+        self.bundles[(p["pg_id"], p["bundle_index"])] = _Bundle(
+            pg_id=p["pg_id"], bundle_index=p["bundle_index"],
+            resources=demand)
+        return {"ok": True}
+
+    async def commit_bundle(self, p):
+        b = self.bundles.get((p["pg_id"], p["bundle_index"]))
+        if b is None:
+            return {"ok": False}
+        b.committed = True
+        self._kick_scheduler()
+        return {"ok": True}
+
+    async def return_bundle(self, p):
+        b = self.bundles.pop((p["pg_id"], p["bundle_index"]), None)
+        if b is not None:
+            self.available = self.available.add(b.resources)
+            self._clamp_available()
+            self._kick_scheduler()
+        return {"ok": True}
+
+    # ------------------------------------------------------ actor lifecycle
+    async def restart_actor(self, p):
+        """Controller asks this node to host a restarted actor."""
+        spec = p["spec"]
+        granted = await self._try_grant({
+            "resources": dict(spec.resources.amounts), "is_actor": True,
+            "actor_id": spec.actor_id, "pg_id": None})
+        if granted is None:
+            return {"ok": False}
+        w = self.workers.get(granted["worker_id"])
+        cli = RpcClient(granted["worker_addr"], tag="agent-restart")
+        try:
+            await cli.connect()
+            r = await cli.call("create_actor", {
+                "spec": spec, "chip_ids": granted["chip_ids"],
+                "lease_id": granted["lease_id"], "is_restart": True})
+            await cli.close()
+            if not r.get("ok"):
+                if w is not None:
+                    w.state = "idle"
+                    w.actor_id = None
+                lease = self.leases.get(granted["lease_id"])
+                if lease:
+                    self._release_lease(lease)
+                return {"ok": False}
+            return {"ok": True}
+        except RpcError:
+            return {"ok": False}
+
+    async def report_actor_failure(self, p):
+        """Worker-side creation failure path (process still alive)."""
+        try:
+            await self._ctl.call("actor_died", p)
+        except RpcError:
+            pass
+        return {"ok": True}
+
+    async def kill_worker(self, p):
+        target: Optional[WorkerEntry] = None
+        if p.get("actor_id") is not None:
+            for w in self.workers.values():
+                if w.actor_id == p["actor_id"]:
+                    target = w
+                    break
+        elif p.get("worker_id") is not None:
+            target = self.workers.get(p["worker_id"])
+        if target is not None and target.proc is not None:
+            try:
+                target.proc.kill()
+            except Exception:
+                pass
+        elif target is not None:
+            try:
+                os.kill(target.pid, signal.SIGKILL)
+            except Exception:
+                pass
+        return {"ok": target is not None}
+
+    # -------------------------------------------------------------- admin
+    async def drain(self, _p):
+        self._draining = True
+        return {"ok": True}
+
+    async def ping(self, _p):
+        return {"ok": True, "node_id": self.node_id}
+
+    async def node_info(self, _p):
+        return {"node_id": self.node_id, "addr": self.server.address,
+                "total": dict(self.total.amounts),
+                "available": dict(self.available.amounts),
+                "workers": len(self.workers),
+                "leases": len(self.leases)}
+
+    async def shutdown(self, _p=None):
+        self._shutdown.set()
+        for w in self.workers.values():
+            if w.proc is not None:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+            else:
+                try:
+                    os.kill(w.pid, signal.SIGKILL)
+                except Exception:
+                    pass
+        for proc in self._spawned_procs:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+        self.directory.clear()
+        self.store.close()
+        asyncio.get_event_loop().call_soon(
+            lambda: asyncio.ensure_future(self.server.stop()))
+        return {"ok": True}
+
+    async def wait_shutdown(self) -> None:
+        await self._shutdown.wait()
+        await asyncio.sleep(0.1)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--session", required=True)
+    parser.add_argument("--controller", required=True)
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=None)
+    parser.add_argument("--resources", type=str, default="")
+    parser.add_argument("--head", action="store_true")
+    parser.add_argument("--ready-fd", type=int, default=-1)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    config = RuntimeConfig.from_env()
+    custom = {}
+    if args.resources:
+        import json
+
+        custom = json.loads(args.resources)
+
+    async def _run():
+        agent = NodeAgent(
+            config, args.session, args.controller,
+            num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+            custom_resources=custom, is_head=args.head)
+        port = await agent.start(args.port)
+        if args.ready_fd >= 0:
+            os.write(args.ready_fd,
+                     f"{port} {agent.node_id.hex()}\n".encode())
+            os.close(args.ready_fd)
+        else:
+            print(f"AGENT_PORT={port}", flush=True)
+        await agent.wait_shutdown()
+
+    try:
+        asyncio.run(_run())
+    except KeyboardInterrupt:
+        pass
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

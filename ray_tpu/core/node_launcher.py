@@ -1,0 +1,114 @@
+"""Spawning of controller / node-agent processes.
+
+Role-equivalent to the reference's service launcher (ref:
+python/ray/_private/services.py start_gcs_server:1445 /
+start_raylet:1523): builds command lines, wires ready-pipes, and captures
+logs under the session directory.  Shared by the driver head bring-up and
+the multi-node test Cluster fixture (ref: python/ray/cluster_utils.py:135).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, Optional, Tuple
+
+from .config import RuntimeConfig
+
+
+def _spawn(args, env, log_path: str, pass_fd: int) -> subprocess.Popen:
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    out = open(log_path, "ab")
+    try:
+        return subprocess.Popen(
+            args, env=env, stdout=out, stderr=subprocess.STDOUT,
+            pass_fds=(pass_fd,), start_new_session=True)
+    finally:
+        out.close()
+
+
+def _read_ready(r_fd: int, proc: subprocess.Popen, what: str,
+                timeout: float = 60.0) -> str:
+    buf = b""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            os.close(r_fd)
+            raise RuntimeError(
+                f"{what} exited during startup (code {proc.returncode})")
+        chunk = os.read(r_fd, 256)
+        if chunk:
+            buf += chunk
+            if b"\n" in buf:
+                break
+        else:
+            break
+    os.close(r_fd)
+    if b"\n" not in buf:
+        raise RuntimeError(f"{what} did not report ready")
+    return buf.decode().strip()
+
+
+def _base_env(config: RuntimeConfig) -> Dict[str, str]:
+    env = dict(os.environ)
+    env.update(config.env_overrides())
+    # Children must find ray_tpu even when the driver got it via a
+    # sys.path edit rather than an installed package.
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    existing = env.get("PYTHONPATH", "")
+    if pkg_parent not in existing.split(os.pathsep):
+        env["PYTHONPATH"] = (pkg_parent + os.pathsep + existing
+                             if existing else pkg_parent)
+    return env
+
+
+def log_dir_of(config: RuntimeConfig, session: str) -> str:
+    return os.path.join(config.session_dir_root, session, "logs")
+
+
+def start_controller(config: RuntimeConfig, session: str,
+                     driver_pid: int = 0
+                     ) -> Tuple[subprocess.Popen, str]:
+    r_fd, w_fd = os.pipe()
+    args = [sys.executable, "-u", "-m", "ray_tpu.core.controller",
+            "--session", session, "--ready-fd", str(w_fd)]
+    if driver_pid:
+        args += ["--driver-pid", str(driver_pid)]
+    proc = _spawn(
+        args, _base_env(config),
+        os.path.join(log_dir_of(config, session), "controller.log"), w_fd)
+    os.close(w_fd)
+    line = _read_ready(r_fd, proc, "controller")
+    return proc, f"127.0.0.1:{int(line.split()[0])}"
+
+
+def start_node_agent(
+    config: RuntimeConfig, session: str, controller_addr: str, *,
+    num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
+    custom_resources: Optional[Dict[str, float]] = None,
+    is_head: bool = False, tag: str = "node",
+) -> Tuple[subprocess.Popen, str, str]:
+    """Returns (process, agent_addr, node_id_hex)."""
+    r_fd, w_fd = os.pipe()
+    args = [sys.executable, "-u", "-m", "ray_tpu.core.node_agent",
+            "--session", session, "--controller", controller_addr,
+            "--ready-fd", str(w_fd)]
+    if is_head:
+        args.append("--head")
+    if num_cpus is not None:
+        args += ["--num-cpus", str(num_cpus)]
+    if num_tpus is not None:
+        args += ["--num-tpus", str(num_tpus)]
+    if custom_resources:
+        args += ["--resources", json.dumps(custom_resources)]
+    proc = _spawn(
+        args, _base_env(config),
+        os.path.join(log_dir_of(config, session), f"agent-{tag}.log"), w_fd)
+    os.close(w_fd)
+    line = _read_ready(r_fd, proc, "node agent")
+    parts = line.split()
+    return proc, f"127.0.0.1:{int(parts[0])}", parts[1]

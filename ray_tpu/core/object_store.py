@@ -1,0 +1,327 @@
+"""The per-node shared-memory object plane and per-process memory store.
+
+Role-equivalent to the reference's plasma store + in-process memory store
+(ref: src/ray/object_manager/plasma/object_lifecycle_manager.h:101,
+src/ray/core_worker/memory_store/memory_store.h:42).  Rebuilt for the TPU
+host model: every object is one POSIX shared-memory segment written
+zero-copy by the producing worker (pickle-5 out-of-band buffers land
+directly in the mapping), readable zero-copy by any process on the node.
+The node agent owns the directory + LRU eviction; producers/consumers only
+touch the agent for registration and lookup, never for the bytes.
+
+Large-array note: numpy/JAX host arrays dominate object bytes; ``pack``
+layout (serialization.py) keeps them as raw contiguous spans so a reader
+can reconstruct arrays as views over the mapping without a copy.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .errors import GetTimeoutError
+from .ids import ObjectID
+from . import serialization
+
+# Suppress resource_tracker interference: segments have explicit lifecycle
+# managed by the node agent, not by Python GC in whichever process mapped
+# them last.  (The stdlib tracker would unlink segments when *any* process
+# that touched them exits.)
+from multiprocessing import resource_tracker as _rt
+
+
+def _untrack(name: str) -> None:
+    try:
+        _rt.unregister("/" + name, "shared_memory")
+    except Exception:
+        pass
+
+
+def _segment_name(session: str, oid: ObjectID) -> str:
+    # /dev/shm names are limited to NAME_MAX; 16-byte hex ids fit easily.
+    return f"rt_{session}_{oid.hex()}"
+
+
+@dataclass
+class StoredObject:
+    """Directory entry for one sealed object in the node store."""
+
+    object_id: ObjectID
+    size: int
+    create_time: float
+
+
+class SharedObjectStore:
+    """Producer/consumer API over per-object shm segments.
+
+    Any process may create+seal or open segments directly; the node agent's
+    ``StoreDirectory`` (below) is the authority on what exists locally and
+    enforces capacity.
+    """
+
+    def __init__(self, session: str):
+        self._session = session
+        # Segments this process currently has mapped (for reads), kept so
+        # memoryviews returned by get() stay valid.
+        self._mapped: Dict[ObjectID, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    # -- producer side ------------------------------------------------------
+    def create_and_seal(self, oid: ObjectID, value: Any) -> int:
+        """Serialize ``value`` straight into a new segment; returns size."""
+        payload, views = serialization.serialize(value)
+        return self.seal_parts(oid, payload, views)
+
+    def seal_parts(self, oid: ObjectID, payload: bytes,
+                   views) -> int:
+        """Write pre-serialized (payload, buffers) into a new segment —
+        lets the executor serialize once and choose inline vs plane."""
+        size = serialization.packed_size(payload, views)
+        seg = self._create_segment(oid, size)
+        try:
+            buf = seg.buf
+            pos = 0
+            buf[pos:pos + 4] = len(views).to_bytes(4, "little"); pos += 4
+            buf[pos:pos + 8] = len(payload).to_bytes(8, "little"); pos += 8
+            buf[pos:pos + len(payload)] = payload; pos += len(payload)
+            for v in views:
+                n = len(v)
+                buf[pos:pos + 8] = n.to_bytes(8, "little"); pos += 8
+                if n:
+                    buf[pos:pos + n] = v
+                pos += n
+        finally:
+            seg.close()
+        return size
+
+    def put_raw(self, oid: ObjectID, data: bytes) -> int:
+        """Write pre-packed bytes (object transfer receive path)."""
+        seg = self._create_segment(oid, len(data))
+        try:
+            seg.buf[:len(data)] = data
+        finally:
+            seg.close()
+        return len(data)
+
+    def _create_segment(self, oid: ObjectID,
+                        size: int) -> shared_memory.SharedMemory:
+        """Create a segment, replacing any stale one with the same name.
+        Objects are immutable, but a retry after a mid-write crash (or two
+        single-machine 'nodes' sharing /dev/shm) can hit an existing name;
+        unlink+recreate keeps old mappings valid for in-flight readers."""
+        name = _segment_name(self._session, oid)
+        try:
+            seg = shared_memory.SharedMemory(name=name, create=True,
+                                             size=max(size, 1))
+        except FileExistsError:
+            old = shared_memory.SharedMemory(name=name)
+            _untrack(old.name)
+            old.close()
+            old.unlink()
+            seg = shared_memory.SharedMemory(name=name, create=True,
+                                             size=max(size, 1))
+        _untrack(seg.name)
+        return seg
+
+    # -- consumer side ------------------------------------------------------
+    def get(self, oid: ObjectID, size: int) -> Any:
+        """Map the segment and deserialize (zero-copy for array spans)."""
+        with self._lock:
+            seg = self._mapped.get(oid)
+            if seg is None:
+                seg = shared_memory.SharedMemory(
+                    name=_segment_name(self._session, oid))
+                _untrack(seg.name)
+                self._mapped[oid] = seg
+        return serialization.unpack(seg.buf[:size])
+
+    def read_raw(self, oid: ObjectID, size: int) -> bytes:
+        """Copy out packed bytes (object transfer send path)."""
+        seg = shared_memory.SharedMemory(
+            name=_segment_name(self._session, oid))
+        _untrack(seg.name)
+        try:
+            return bytes(seg.buf[:size])
+        finally:
+            seg.close()
+
+    def contains(self, oid: ObjectID) -> bool:
+        try:
+            seg = shared_memory.SharedMemory(
+                name=_segment_name(self._session, oid))
+            _untrack(seg.name)
+            seg.close()
+            return True
+        except FileNotFoundError:
+            return False
+
+    def release(self, oid: ObjectID) -> None:
+        with self._lock:
+            seg = self._mapped.pop(oid, None)
+        if seg is not None:
+            try:
+                seg.close()
+            except Exception:
+                pass
+
+    def delete(self, oid: ObjectID) -> None:
+        self.release(oid)
+        try:
+            seg = shared_memory.SharedMemory(
+                name=_segment_name(self._session, oid))
+            _untrack(seg.name)
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            for seg in self._mapped.values():
+                try:
+                    seg.close()
+                except Exception:
+                    pass
+            self._mapped.clear()
+
+
+class StoreDirectory:
+    """Node-agent-side authority over local objects: registration, LRU
+    eviction under capacity pressure, pinning (ref: plasma eviction_policy.h
+    + object_lifecycle_manager.h)."""
+
+    def __init__(self, store: SharedObjectStore, capacity_bytes: int):
+        self._store = store
+        self._capacity = capacity_bytes
+        self._entries: "OrderedDict[ObjectID, StoredObject]" = OrderedDict()
+        self._pinned: Set[ObjectID] = set()
+        self._used = 0
+        self._lock = threading.Lock()
+
+    def register(self, oid: ObjectID, size: int) -> List[ObjectID]:
+        """Record a sealed object; returns ids evicted to make room."""
+        evicted: List[ObjectID] = []
+        with self._lock:
+            if oid in self._entries:
+                return []
+            self._entries[oid] = StoredObject(oid, size, time.time())
+            self._entries.move_to_end(oid)
+            self._used += size
+            while self._used > self._capacity:
+                victim = None
+                for vid in self._entries:
+                    if vid != oid and vid not in self._pinned:
+                        victim = vid
+                        break
+                if victim is None:
+                    break
+                ent = self._entries.pop(victim)
+                self._used -= ent.size
+                evicted.append(victim)
+        for vid in evicted:
+            self._store.delete(vid)
+        return evicted
+
+    def lookup(self, oid: ObjectID) -> Optional[StoredObject]:
+        with self._lock:
+            ent = self._entries.get(oid)
+            if ent is not None:
+                self._entries.move_to_end(oid)
+            return ent
+
+    def pin(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._pinned.add(oid)
+
+    def unpin(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._pinned.discard(oid)
+
+    def delete(self, oid: ObjectID) -> bool:
+        with self._lock:
+            ent = self._entries.pop(oid, None)
+            self._pinned.discard(oid)
+            if ent is not None:
+                self._used -= ent.size
+        if ent is not None:
+            self._store.delete(oid)
+            return True
+        return False
+
+    def stats(self) -> Tuple[int, int, int]:
+        with self._lock:
+            return len(self._entries), self._used, self._capacity
+
+    def all_ids(self) -> List[ObjectID]:
+        with self._lock:
+            return list(self._entries)
+
+    def clear(self) -> None:
+        for oid in self.all_ids():
+            self.delete(oid)
+
+
+class _PendingEntry:
+    __slots__ = ("event", "value", "has_value")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.has_value = False
+
+
+class MemoryStore:
+    """Per-process store for inlined small values and result descriptors,
+    with blocking waits (ref: memory_store.h:42 GetAsync futures)."""
+
+    def __init__(self):
+        self._values: Dict[ObjectID, Any] = {}
+        self._waiting: Dict[ObjectID, _PendingEntry] = {}
+        self._lock = threading.Lock()
+
+    def put(self, oid: ObjectID, value: Any) -> None:
+        with self._lock:
+            self._values[oid] = value
+            ent = self._waiting.pop(oid, None)
+        if ent is not None:
+            ent.value = value
+            ent.has_value = True
+            ent.event.set()
+
+    def get_nowait(self, oid: ObjectID) -> Tuple[bool, Any]:
+        with self._lock:
+            if oid in self._values:
+                return True, self._values[oid]
+        return False, None
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._values
+
+    def wait_for(self, oid: ObjectID, timeout: Optional[float]) -> Any:
+        with self._lock:
+            if oid in self._values:
+                return self._values[oid]
+            ent = self._waiting.get(oid)
+            if ent is None:
+                ent = self._waiting[oid] = _PendingEntry()
+        if not ent.event.wait(timeout):
+            raise GetTimeoutError(
+                f"object {oid.hex()[:16]} not ready within {timeout}s")
+        return ent.value
+
+    def delete(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._values.pop(oid, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+            for ent in self._waiting.values():
+                ent.event.set()
+            self._waiting.clear()

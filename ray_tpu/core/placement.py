@@ -1,0 +1,236 @@
+"""Placement groups: gang reservation of resource bundles across nodes.
+
+Role-equivalent to the reference's GcsPlacementGroupManager/Scheduler with
+its two-phase prepare/commit protocol (ref:
+src/ray/gcs/gcs_server/gcs_placement_group_scheduler.h, strategies in
+python/ray/util/placement_group.py:145).  TPU-era framing: a bundle is
+typically one TPU host's chips; STRICT_SPREAD maps slices across hosts so a
+gang-scheduled worker group aligns 1:1 with the jax.distributed world.
+
+Controller-side manager (this file) + client API (placement_api.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .ids import NodeID, PlacementGroupID
+from .rpc import RpcError
+
+logger = logging.getLogger("ray_tpu.placement")
+
+PENDING = "PENDING"
+CREATED = "CREATED"
+REMOVED = "REMOVED"
+RESCHEDULING = "RESCHEDULING"
+
+STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+@dataclass
+class PGEntry:
+    pg_id: PlacementGroupID
+    bundles: List[Dict[str, float]]
+    strategy: str
+    state: str = PENDING
+    name: str = ""
+    # bundle index -> node id (filled at commit)
+    placement: Dict[int, NodeID] = field(default_factory=dict)
+    create_time: float = field(default_factory=time.time)
+    waiters: List[asyncio.Event] = field(default_factory=list)
+
+
+def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+
+def _sub(avail: Dict[str, float], demand: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+class PlacementGroupManager:
+    def __init__(self, controller):
+        self._ctl = controller
+        self._groups: Dict[PlacementGroupID, PGEntry] = {}
+
+    # ------------------------------------------------------------- placement
+    def _plan(self, entry: PGEntry) -> Optional[Dict[int, NodeID]]:
+        """Bin-pack bundles onto alive nodes per strategy (ref:
+        BundleSchedulingPolicy in src/ray/raylet/scheduling/policy/)."""
+        nodes = [n for n in self._ctl.nodes.values() if n.alive]
+        if not nodes:
+            return None
+        avail = {n.node_id: dict(n.resources_available) for n in nodes}
+        plan: Dict[int, NodeID] = {}
+        strategy = entry.strategy
+        order = sorted(range(len(entry.bundles)),
+                       key=lambda i: -sum(entry.bundles[i].values()))
+        if strategy in ("PACK", "STRICT_PACK"):
+            # Try to place everything on a single node first.
+            for n in nodes:
+                trial = dict(avail[n.node_id])
+                if all(_fits(trial, entry.bundles[i]) or True for i in order):
+                    ok = True
+                    t2 = dict(avail[n.node_id])
+                    for i in order:
+                        if not _fits(t2, entry.bundles[i]):
+                            ok = False
+                            break
+                        _sub(t2, entry.bundles[i])
+                    if ok:
+                        return {i: n.node_id for i in order}
+            if strategy == "STRICT_PACK":
+                return None
+            # Soft PACK: greedy fill, spill to other nodes.
+            for i in order:
+                placed = False
+                for n in nodes:
+                    if _fits(avail[n.node_id], entry.bundles[i]):
+                        _sub(avail[n.node_id], entry.bundles[i])
+                        plan[i] = n.node_id
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            return plan
+        # SPREAD family: round-robin across distinct nodes.
+        used_nodes: List[NodeID] = []
+        for i in order:
+            candidates = sorted(
+                nodes, key=lambda n: (n.node_id in used_nodes,
+                                      -sum(avail[n.node_id].values())))
+            placed = False
+            for n in candidates:
+                if strategy == "STRICT_SPREAD" and n.node_id in used_nodes:
+                    continue
+                if _fits(avail[n.node_id], entry.bundles[i]):
+                    _sub(avail[n.node_id], entry.bundles[i])
+                    plan[i] = n.node_id
+                    used_nodes.append(n.node_id)
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return plan
+
+    async def _try_commit(self, entry: PGEntry) -> bool:
+        plan = self._plan(entry)
+        if plan is None:
+            return False
+        # Phase 1: prepare — reserve on every node, all-or-nothing.
+        prepared: List[int] = []
+        ok = True
+        for idx, node_id in plan.items():
+            cli = await self._ctl._agent(node_id)
+            if cli is None:
+                ok = False
+                break
+            try:
+                r = await cli.call("prepare_bundle", {
+                    "pg_id": entry.pg_id, "bundle_index": idx,
+                    "resources": entry.bundles[idx]})
+            except RpcError:
+                ok = False
+                break
+            if not r.get("ok"):
+                ok = False
+                break
+            prepared.append(idx)
+        if not ok:
+            for idx in prepared:
+                cli = await self._ctl._agent(plan[idx])
+                if cli is not None:
+                    try:
+                        await cli.call("return_bundle", {
+                            "pg_id": entry.pg_id, "bundle_index": idx})
+                    except RpcError:
+                        pass
+            return False
+        # Phase 2: commit.
+        for idx, node_id in plan.items():
+            cli = await self._ctl._agent(node_id)
+            if cli is not None:
+                try:
+                    await cli.call("commit_bundle", {
+                        "pg_id": entry.pg_id, "bundle_index": idx})
+                except RpcError:
+                    pass
+        entry.placement = plan
+        entry.state = CREATED
+        for ev in entry.waiters:
+            ev.set()
+        entry.waiters.clear()
+        self._ctl._publish("placement_group",
+                           {"pg_id": entry.pg_id, "state": CREATED})
+        return True
+
+    async def _schedule_loop(self, entry: PGEntry) -> None:
+        delay = 0.05
+        while entry.state in (PENDING, RESCHEDULING):
+            if await self._try_commit(entry):
+                return
+            await asyncio.sleep(delay)
+            delay = min(delay * 1.5, 2.0)
+
+    # ----------------------------------------------------------------- RPCs
+    async def create(self, p):
+        strategy = p.get("strategy", "PACK")
+        if strategy not in STRATEGIES:
+            return {"ok": False, "error": f"unknown strategy {strategy!r}"}
+        entry = PGEntry(pg_id=p["pg_id"], bundles=p["bundles"],
+                        strategy=strategy, name=p.get("name", ""))
+        self._groups[entry.pg_id] = entry
+        asyncio.ensure_future(self._schedule_loop(entry))
+        return {"ok": True}
+
+    async def remove(self, p):
+        entry = self._groups.get(p["pg_id"])
+        if entry is None:
+            return {"ok": True}
+        entry.state = REMOVED
+        for idx, node_id in entry.placement.items():
+            cli = await self._ctl._agent(node_id)
+            if cli is not None:
+                try:
+                    await cli.call("return_bundle", {
+                        "pg_id": entry.pg_id, "bundle_index": idx})
+                except RpcError:
+                    pass
+        entry.placement.clear()
+        for ev in entry.waiters:
+            ev.set()
+        self._ctl._publish("placement_group",
+                           {"pg_id": entry.pg_id, "state": REMOVED})
+        return {"ok": True}
+
+    def get(self, p):
+        entry = self._groups.get(p["pg_id"])
+        if entry is None:
+            return None
+        placement = {
+            idx: {"node_id": nid,
+                  "agent_addr": self._ctl.nodes[nid].agent_addr
+                  if nid in self._ctl.nodes else ""}
+            for idx, nid in entry.placement.items()
+        }
+        return {"pg_id": entry.pg_id, "state": entry.state,
+                "bundles": entry.bundles, "strategy": entry.strategy,
+                "placement": placement, "name": entry.name}
+
+    def list_all(self, _p):
+        return [self.get({"pg_id": pid}) for pid in self._groups]
+
+    async def on_node_dead(self, node_id: NodeID) -> None:
+        for entry in self._groups.values():
+            if entry.state == CREATED and node_id in entry.placement.values():
+                entry.state = RESCHEDULING
+                entry.placement = {}
+                self._ctl._publish("placement_group",
+                                   {"pg_id": entry.pg_id,
+                                    "state": RESCHEDULING})
+                asyncio.ensure_future(self._schedule_loop(entry))

@@ -1,0 +1,337 @@
+"""Lightweight asyncio RPC used by every control-plane process.
+
+Role-equivalent to the reference's gRPC layer (ref: src/ray/rpc/ —
+GrpcServer, ClientCallManager) rebuilt on asyncio streams with
+length-prefixed pickled frames.  Design notes for the TPU build: the
+control plane only moves small host metadata (tensors move in-graph over
+ICI or through the shared-memory object plane), so a single-connection
+multiplexed byte protocol is sufficient and keeps the runtime free of
+codegen; retries/reconnects live in ``RpcClient`` the way the reference
+puts them in ``retryable_grpc_client``.
+
+Frame layout: ``u32 length | pickled (kind, req_id, method, payload)`` where
+kind is REQUEST/RESPONSE/ERROR/NOTIFY.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import pickle
+import threading
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+import cloudpickle
+
+logger = logging.getLogger(__name__)
+
+_REQUEST = 0
+_RESPONSE = 1
+_ERROR = 2
+_NOTIFY = 3
+
+_MAX_FRAME = 1 << 34  # 16 GiB safety cap for object transfer frames
+
+
+class RpcError(ConnectionError):
+    """Transport-level failure (peer died / connection refused)."""
+
+
+class RemoteCallError(Exception):
+    """The handler on the peer raised; carries the original exception."""
+
+    def __init__(self, cause: BaseException):
+        self.cause = cause
+        super().__init__(repr(cause))
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Tuple:
+    header = await reader.readexactly(4)
+    n = int.from_bytes(header, "little")
+    if n > _MAX_FRAME:
+        raise RpcError(f"frame too large: {n}")
+    data = await reader.readexactly(n)
+    return pickle.loads(data)
+
+
+def _encode_frame(msg: Tuple) -> bytes:
+    data = cloudpickle.dumps(msg, protocol=5)
+    return len(data).to_bytes(4, "little") + data
+
+
+class RpcServer:
+    """Serves named async handlers.  ``handler(payload) -> result``.
+
+    Handlers registered via ``register(name, fn)``; ``fn`` may be a plain
+    function or a coroutine function.  Raising inside a handler sends an
+    ERROR frame that re-raises at the caller as ``RemoteCallError``.
+    """
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self._host = host
+        self._handlers: Dict[str, Callable[[Any], Awaitable[Any]]] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.port: int = 0
+        self._conn_lost_cb: Optional[Callable[[str], None]] = None
+        self._conns: Dict[str, asyncio.StreamWriter] = {}
+        self._conn_counter = itertools.count()
+
+    def register(self, name: str, fn: Callable) -> None:
+        self._handlers[name] = fn
+
+    def on_connection_lost(self, cb: Callable[[str], None]) -> None:
+        """cb(peer_tag) fires when a registered peer's connection drops."""
+        self._conn_lost_cb = cb
+
+    async def start(self, port: int = 0) -> int:
+        self._server = await asyncio.start_server(
+            self._serve_conn, self._host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self.port}"
+
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        peer_tag = f"conn-{next(self._conn_counter)}"
+        write_lock = asyncio.Lock()
+        try:
+            while True:
+                try:
+                    msg = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                kind, req_id, method, payload = msg
+                if kind == _NOTIFY:
+                    # Special registration notify lets servers track peers.
+                    if method == "__register__":
+                        peer_tag = payload
+                        self._conns[peer_tag] = writer
+                        continue
+                    asyncio.ensure_future(
+                        self._dispatch_notify(method, payload))
+                    continue
+                asyncio.ensure_future(
+                    self._dispatch(method, payload, req_id, writer,
+                                   write_lock))
+        finally:
+            self._conns.pop(peer_tag, None)
+            if self._conn_lost_cb is not None:
+                try:
+                    self._conn_lost_cb(peer_tag)
+                except Exception:
+                    logger.exception("connection-lost callback failed")
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch_notify(self, method: str, payload: Any) -> None:
+        fn = self._handlers.get(method)
+        if fn is None:
+            logger.warning("no handler for notify %s", method)
+            return
+        try:
+            r = fn(payload)
+            if asyncio.iscoroutine(r):
+                await r
+        except Exception:
+            logger.exception("notify handler %s failed", method)
+
+    async def _dispatch(self, method: str, payload: Any, req_id: int,
+                        writer: asyncio.StreamWriter,
+                        write_lock: asyncio.Lock) -> None:
+        fn = self._handlers.get(method)
+        try:
+            if fn is None:
+                raise LookupError(f"no RPC handler {method!r}")
+            result = fn(payload)
+            if asyncio.iscoroutine(result):
+                result = await result
+            frame = _encode_frame((_RESPONSE, req_id, method, result))
+        except BaseException as e:  # noqa: BLE001 — shipped to caller
+            try:
+                frame = _encode_frame((_ERROR, req_id, method, e))
+            except Exception:
+                frame = _encode_frame(
+                    (_ERROR, req_id, method, RuntimeError(repr(e))))
+        try:
+            async with write_lock:
+                writer.write(frame)
+                await writer.drain()
+        except (ConnectionError, RuntimeError):
+            pass  # peer went away; nothing to do
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+            self._server = None
+
+
+class RpcClient:
+    """A multiplexed client connection to one RpcServer.
+
+    All calls share one TCP connection; responses are matched by request
+    id.  Not thread-safe by itself — all use goes through the owning
+    event loop (see ``EventLoopThread`` for sync callers).
+    """
+
+    def __init__(self, address: str, *, tag: str = "",
+                 connect_timeout: float = 30.0):
+        self.address = address
+        host, port = address.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self._tag = tag
+        self._connect_timeout = connect_timeout
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._req_counter = itertools.count(1)
+        self._read_task: Optional[asyncio.Task] = None
+        self._lock = asyncio.Lock()
+        self._closed = False
+
+    async def connect(self) -> None:
+        async with self._lock:
+            if self._writer is not None or self._closed:
+                return
+            deadline = asyncio.get_event_loop().time() + self._connect_timeout
+            last_err: Optional[Exception] = None
+            while asyncio.get_event_loop().time() < deadline:
+                try:
+                    self._reader, self._writer = await asyncio.open_connection(
+                        self._host, self._port)
+                    break
+                except OSError as e:
+                    last_err = e
+                    await asyncio.sleep(0.05)
+            else:
+                raise RpcError(
+                    f"cannot connect to {self.address}: {last_err}")
+            if self._tag:
+                self._writer.write(
+                    _encode_frame((_NOTIFY, 0, "__register__", self._tag)))
+                await self._writer.drain()
+            self._read_task = asyncio.ensure_future(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                kind, req_id, _method, payload = await _read_frame(
+                    self._reader)
+                fut = self._pending.pop(req_id, None)
+                if fut is None or fut.done():
+                    continue
+                if kind == _ERROR:
+                    fut.set_exception(RemoteCallError(payload)
+                                      if not isinstance(payload, RpcError)
+                                      else payload)
+                else:
+                    fut.set_result(payload)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except Exception:
+            logger.exception("rpc read loop crashed (%s)", self.address)
+        finally:
+            self._fail_pending(RpcError(f"connection to {self.address} lost"))
+            self._writer = None
+            self._reader = None
+
+    def _fail_pending(self, err: Exception) -> None:
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(err)
+        self._pending.clear()
+
+    async def call(self, method: str, payload: Any = None,
+                   timeout: Optional[float] = None) -> Any:
+        if self._writer is None:
+            await self.connect()
+        req_id = next(self._req_counter)
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[req_id] = fut
+        try:
+            assert self._writer is not None
+            self._writer.write(
+                _encode_frame((_REQUEST, req_id, method, payload)))
+            await self._writer.drain()
+        except (ConnectionError, OSError, AssertionError) as e:
+            self._pending.pop(req_id, None)
+            raise RpcError(f"send to {self.address} failed: {e}") from e
+        if timeout:
+            return await asyncio.wait_for(fut, timeout)
+        return await fut
+
+    async def notify(self, method: str, payload: Any = None) -> None:
+        if self._writer is None:
+            await self.connect()
+        assert self._writer is not None
+        try:
+            self._writer.write(_encode_frame((_NOTIFY, 0, method, payload)))
+            await self._writer.drain()
+        except (ConnectionError, OSError) as e:
+            raise RpcError(f"notify to {self.address} failed: {e}") from e
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._read_task is not None:
+            self._read_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+            self._writer = None
+        self._fail_pending(RpcError("client closed"))
+
+
+class EventLoopThread:
+    """A dedicated event-loop thread for synchronous processes (the driver
+    and task-executing workers), mirroring how the reference keeps the
+    CoreWorker's io_service off the user thread (ref:
+    src/ray/core_worker/core_worker.h io_service_)."""
+
+    def __init__(self, name: str = "rt-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: Optional[float] = None):
+        """Run a coroutine on the loop from a foreign thread, blocking."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro) -> "asyncio.Future":
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def call_soon(self, fn, *args) -> None:
+        self.loop.call_soon_threadsafe(fn, *args)
+
+    def stop(self) -> None:
+        def _shutdown():
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+            self.loop.stop()
+
+        try:
+            self.loop.call_soon_threadsafe(_shutdown)
+            self._thread.join(timeout=5)
+        except Exception:
+            pass

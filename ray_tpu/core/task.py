@@ -76,6 +76,8 @@ class TaskSpec:
     actor_name: str = ""               # named actor registration
     namespace: str = ""
     seq_no: int = 0                    # per-actor submission order
+    method_names: List[str] = field(default_factory=list)  # actor methods
+    lifetime: Optional[str] = None     # None | "detached"
     # Lineage: owner address is attached by the submitting worker.
     owner_hint: str = ""
 

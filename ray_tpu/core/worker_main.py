@@ -1,0 +1,304 @@
+"""Worker process entry point — executes tasks and hosts actors.
+
+Role-equivalent to the reference's default_worker.py + the execution half
+of CoreWorker (ref: python/ray/_private/workers/default_worker.py, task
+execution handler _raylet.pyx:2244, TaskReceiver + ActorSchedulingQueue in
+src/ray/core_worker/transport/task_receiver.h).  The worker registers with
+its node agent, serves direct task pushes from owners, and on actor
+creation becomes that actor's dedicated process with per-caller ordered
+method queues, a thread pool honoring ``max_concurrency``, and native
+asyncio execution for coroutine methods.
+
+TPU isolation: chip ids granted with the lease are exported as
+``TPU_VISIBLE_CHIPS`` *before* any user code imports jax, the analogue of
+the reference's per-worker CUDA_VISIBLE_DEVICES handling (ref:
+python/ray/_private/accelerators/tpu.py TPU_VISIBLE_CHIPS).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import os
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from . import runtime as runtime_mod
+from . import serialization
+from .cluster_runtime import ClusterRuntime
+from .config import RuntimeConfig
+from .errors import ActorError, TaskError
+from .ids import ActorID, JobID, WorkerID
+from .rpc import RpcClient, RpcServer
+from .task import ArgKind, TaskResult, TaskSpec
+
+logger = logging.getLogger("ray_tpu.worker")
+
+
+class Worker:
+    def __init__(self):
+        self.session = os.environ["RT_SESSION_NAME"]
+        self.controller_addr = os.environ["RT_CONTROLLER_ADDR"]
+        self.agent_addr = os.environ["RT_AGENT_ADDR"]
+        self.node_id_hex = os.environ["RT_NODE_ID"]
+        self.config = RuntimeConfig.from_env()
+        self.worker_id = WorkerID.from_random()
+        self.server = RpcServer()
+        self.runtime: Optional[ClusterRuntime] = None
+        self._func_cache: Dict[str, Any] = {}
+        self._task_executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="task-exec")
+        # Actor state.
+        self.actor_id: Optional[ActorID] = None
+        self.actor_instance: Any = None
+        self.actor_executor: Optional[ThreadPoolExecutor] = None
+        self.actor_lock = threading.Lock()
+        self._exit_event = asyncio.Event()
+        for name in ["push_task", "create_actor", "push_actor_task",
+                     "ping", "exit"]:
+            self.server.register(name, getattr(self, name))
+
+    async def start(self) -> None:
+        await self.server.start()
+        self.runtime = ClusterRuntime(
+            self.config,
+            _connect={"session": self.session,
+                      "controller": self.controller_addr,
+                      "agent": self.agent_addr},
+            _job_id=JobID.from_int(0))
+        runtime_mod.set_runtime(self.runtime)
+        agent = RpcClient(self.agent_addr,
+                          tag=f"worker-{self.worker_id.hex()[:8]}",
+                          connect_timeout=10.0)
+        await agent.connect()
+        await agent.call("register_worker", {
+            "worker_id": self.worker_id, "addr": self.server.address,
+            "pid": os.getpid()})
+        self._agent = agent
+        asyncio.ensure_future(self._watch_agent())
+
+    async def _watch_agent(self) -> None:
+        """Exit when the node agent goes away — a worker without its node
+        has no store, no lease ledger, and no reason to live."""
+        while True:
+            await asyncio.sleep(1.0)
+            if not self._agent.connected:
+                logging.warning("agent connection lost; worker exiting")
+                os._exit(0)
+
+    # ------------------------------------------------------------ execution
+    def _load_func(self, spec: TaskSpec):
+        fn = self._func_cache.get(spec.func_id)
+        if fn is None:
+            fn = cloudpickle.loads(spec.func_blob)
+            self._func_cache[spec.func_id] = fn
+        return fn
+
+    def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        from .object_ref import ObjectRef
+
+        vals = []
+        for a in spec.args:
+            if a.kind == ArgKind.OBJECT_REF:
+                vals.append(self.runtime.get(
+                    [ObjectRef(a.object_id)], None)[0])
+            else:
+                vals.append(a.value)
+        nkw = len(spec.kwargs_keys)
+        if nkw:
+            pos, kw_vals = vals[:-nkw], vals[-nkw:]
+            return pos, dict(zip(spec.kwargs_keys, kw_vals))
+        return vals, {}
+
+    def _package_returns(self, spec: TaskSpec, result: Any) -> TaskResult:
+        if spec.num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"Task {spec.display_name()} declared "
+                    f"num_returns={spec.num_returns}, returned "
+                    f"{len(values)}")
+        entries = []
+        oids = spec.return_object_ids()
+        for oid, value in zip(oids, values):
+            payload, views = serialization.serialize(value)
+            size = serialization.packed_size(payload, views)
+            if size <= self.config.object_inline_max_bytes:
+                buf = bytearray(size)
+                pos = 0
+                buf[pos:pos + 4] = len(views).to_bytes(4, "little"); pos += 4
+                buf[pos:pos + 8] = len(payload).to_bytes(8, "little"); pos += 8
+                buf[pos:pos + len(payload)] = payload; pos += len(payload)
+                for v in views:
+                    n = len(v)
+                    buf[pos:pos + 8] = n.to_bytes(8, "little"); pos += 8
+                    buf[pos:pos + n] = v; pos += n
+                entries.append(("inline", bytes(buf)))
+            else:
+                self.runtime.store.seal_parts(oid, payload, views)
+                self.runtime.agent_call(
+                    "register_object", {"object_id": oid, "size": size})
+                entries.append(("store", (size, self.node_id_hex)))
+        return TaskResult(task_id=spec.task_id, ok=True, returns=entries)
+
+    def _execute_sync(self, spec: TaskSpec, fn, lease_id: Optional[int],
+                      chip_ids: List[int]) -> TaskResult:
+        if chip_ids:
+            os.environ["TPU_VISIBLE_CHIPS"] = ",".join(map(str, chip_ids))
+            os.environ.setdefault("TPU_CHIPS_PER_PROCESS_BOUNDS",
+                                  f"1,{len(chip_ids)},1")
+        prev_lease = self.runtime.current_lease_id
+        if lease_id is not None:
+            self.runtime.current_lease_id = lease_id
+        prev_task = self.runtime._ctx.current_task_id
+        self.runtime.set_current_task(spec.task_id)
+        try:
+            pos, kwargs = self._resolve_args(spec)
+            result = fn(*pos, **kwargs)
+            return self._package_returns(spec, result)
+        except BaseException as e:  # noqa: BLE001 — shipped to owner
+            kind = ActorError if spec.kind.name == "ACTOR_TASK" else TaskError
+            return TaskResult(task_id=spec.task_id, ok=False,
+                              error=kind.from_exception(e))
+        finally:
+            self.runtime.set_current_task(prev_task)
+            self.runtime.current_lease_id = prev_lease
+
+    # ---------------------------------------------------------- normal task
+    async def push_task(self, p) -> TaskResult:
+        spec: TaskSpec = p["spec"]
+        fn = self._load_func(spec)
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(
+            self._task_executor, self._execute_sync, spec, fn,
+            p.get("lease_id"), p.get("chip_ids") or [])
+
+    # -------------------------------------------------------------- actors
+    async def create_actor(self, p):
+        spec: TaskSpec = p["spec"]
+        chip_ids = p.get("chip_ids") or []
+        if chip_ids:
+            os.environ["TPU_VISIBLE_CHIPS"] = ",".join(map(str, chip_ids))
+        self.runtime.current_lease_id = p.get("lease_id")
+        cls = self._load_func(spec)
+        loop = asyncio.get_event_loop()
+
+        def _construct():
+            self.runtime.set_current_task(spec.task_id)
+            try:
+                pos, kwargs = self._resolve_args(spec)
+                return cls(*pos, **kwargs), None
+            except BaseException as e:  # noqa: BLE001
+                tb = traceback.format_exc()
+                return None, (e, tb)
+            finally:
+                self.runtime.set_current_task(None)
+
+        instance, err = await loop.run_in_executor(
+            self._task_executor, _construct)
+        if err is not None:
+            exc, tb = err
+            await self._agent.call("report_actor_failure", {
+                "actor_id": spec.actor_id, "creation_failed": True,
+                "reason": f"__init__ raised {exc!r}\n{tb}"})
+            # Exit so the agent reaps this worker and frees the lease —
+            # a worker that ran a failing __init__ may hold partial state.
+            asyncio.get_event_loop().call_later(0.2, self._exit_event.set)
+            return {"ok": False, "error": repr(exc)}
+        self.actor_id = spec.actor_id
+        self.actor_instance = instance
+        n = max(1, spec.max_concurrency)
+        self.actor_executor = ThreadPoolExecutor(
+            max_workers=n, thread_name_prefix="actor-exec")
+        self._actor_max_concurrency = n
+        ctl = RpcClient(self.controller_addr,
+                        tag=f"actor-{spec.actor_id.hex()[:8]}")
+        await ctl.connect()
+        from .ids import NodeID
+
+        r = await ctl.call("actor_started", {
+            "actor_id": spec.actor_id,
+            "node_id": NodeID.from_hex(self.node_id_hex),
+            "worker_addr": self.server.address})
+        await ctl.close()
+        if r.get("kill"):
+            self._exit_event.set()
+            return {"ok": False, "error": "actor killed during creation"}
+        return {"ok": True}
+
+    async def push_actor_task(self, p) -> TaskResult:
+        spec: TaskSpec = p["spec"]
+        caller = p.get("caller_id", "?")
+        if self.actor_instance is None:
+            return TaskResult(
+                task_id=spec.task_id, ok=False,
+                error=ActorError.from_exception(
+                    RuntimeError("actor not initialized on this worker")))
+        method = getattr(self.actor_instance, spec.method_name, None)
+        if method is None:
+            return TaskResult(
+                task_id=spec.task_id, ok=False,
+                error=ActorError.from_exception(AttributeError(
+                    f"actor has no method {spec.method_name!r}")))
+        # Ordering: owners serialize max_concurrency=1 submissions, frames
+        # arrive in order per connection, and handler tasks + the actor
+        # executor are FIFO — so arrival order IS execution order here.
+        del caller
+        if inspect.iscoroutinefunction(method):
+            return await self._run_async_method(spec, method)
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(
+            self.actor_executor, self._execute_sync, spec, method, None, [])
+
+    async def _run_async_method(self, spec: TaskSpec, method) -> TaskResult:
+        self.runtime.set_current_task(spec.task_id)
+        try:
+            pos, kwargs = self._resolve_args(spec)
+            result = await method(*pos, **kwargs)
+            return self._package_returns(spec, result)
+        except BaseException as e:  # noqa: BLE001
+            return TaskResult(task_id=spec.task_id, ok=False,
+                              error=ActorError.from_exception(e))
+        finally:
+            self.runtime.set_current_task(None)
+
+    # --------------------------------------------------------------- admin
+    async def ping(self, _p):
+        return {"ok": True, "actor": self.actor_id.hex()
+                if self.actor_id else None}
+
+    async def exit(self, _p):
+        self._exit_event.set()
+        return {"ok": True}
+
+    async def run_forever(self):
+        await self._exit_event.wait()
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(asctime)s worker[{os.getpid()}] %(levelname)s %(message)s")
+
+    async def _run():
+        w = Worker()
+        await w.start()
+        await w.run_forever()
+
+    try:
+        asyncio.run(_run())
+    except KeyboardInterrupt:
+        pass
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

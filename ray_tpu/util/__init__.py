@@ -1,0 +1,10 @@
+"""ray_tpu.util — placement, scheduling strategies, collectives, state.
+
+Role-equivalent to the reference's python/ray/util/ package surface.
+"""
+
+from .placement_group import (PlacementGroup, get_placement_group,  # noqa
+                              placement_group, remove_placement_group)
+from .scheduling_strategies import (NodeAffinitySchedulingStrategy,  # noqa
+                                    NodeLabelSchedulingStrategy,
+                                    PlacementGroupSchedulingStrategy)

@@ -1,0 +1,38 @@
+"""User-facing scheduling strategies.
+
+Role-equivalent to the reference's scheduling_strategies (ref:
+python/ray/util/scheduling_strategies.py): placement-group binding,
+node-affinity, spread, and a TPU-era label matcher for slice affinity.
+Converted to the internal SchedulingStrategy in core/api.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..core.ids import NodeID
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: "object"           # util.placement_group.PlacementGroup
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: str                        # hex node id
+    soft: bool = False
+
+    def to_node_id(self) -> NodeID:
+        return NodeID.from_hex(self.node_id)
+
+
+@dataclass
+class NodeLabelSchedulingStrategy:
+    """Match nodes by label (TPU slice/pod affinity)."""
+
+    hard: Optional[dict] = None
+    soft: Optional[dict] = None
