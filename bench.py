@@ -1,0 +1,98 @@
+"""Headline benchmark: GPT-2 pretraining throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric is tokens/sec/chip for a GPT-2 (124M) training step (bf16, remat),
+the BASELINE.json headline.  vs_baseline = achieved MFU / 0.35 (the north
+star: >=35% MFU GPT-2 pretrain with no CUDA in the wheel).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# bf16 peak FLOPs/s per chip by TPU generation (public spec sheets).
+_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def main() -> None:
+    import os
+
+    from ray_tpu.models.gpt2 import (GPT2Config, gpt2_init, gpt2_loss_fn)
+    from ray_tpu.train.train_step import (TrainState, make_optimizer,
+                                          make_sharded_train_step)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    # GPT-2 small on a real chip; a scaled-down copy on CPU so the bench
+    # stays runnable anywhere (vs_baseline is only meaningful on TPU).
+    if on_tpu:
+        cfg = GPT2Config(n_layer=12, n_head=12, d_model=768, d_ff=3072,
+                         vocab_size=50257, max_seq=1024, remat=True)
+        batch, steps = 8, 8
+    else:
+        cfg = GPT2Config(vocab_size=2048, n_layer=4, n_head=8, d_model=256,
+                         d_ff=1024, max_seq=256, remat=True)
+        batch, steps = 4, 3
+
+    params = gpt2_init(cfg, jax.random.PRNGKey(0))
+    optimizer = make_optimizer(total_steps=1000)
+    state = TrainState.create(params, optimizer)
+    state = jax.device_put(state)
+
+    def loss_fn(p, b):
+        return gpt2_loss_fn(cfg, p, b)
+
+    from ray_tpu.train.train_step import make_train_step
+
+    one_step = make_train_step(loss_fn, optimizer)
+    tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                (batch, cfg.max_seq + 1), 0,
+                                cfg.vocab_size, jnp.int32)
+
+    # The measured loop runs INSIDE one jit (lax.scan over steps): a
+    # host-free training loop is the TPU-idiomatic shape AND the only
+    # honest timing through an async dispatch tunnel — sync via
+    # device_get of the scalar loss (block_until_ready is not a reliable
+    # barrier on the axon relay platform).
+    def run(state, tokens, n):
+        def body(s, _):
+            s, m = one_step(s, {"tokens": tokens})
+            return s, m["loss"]
+        state, losses = jax.lax.scan(body, state, None, length=n)
+        return state, losses[-1]
+
+    runner = jax.jit(run, static_argnums=(2,), donate_argnums=(0,))
+    # Warm up with the SAME step count (static arg => per-n executable;
+    # timing a fresh n would measure compilation, not training).
+    state, loss = runner(state, tokens, steps)
+    _ = jax.device_get(loss)
+
+    t0 = time.perf_counter()
+    state, loss = runner(state, tokens, steps)
+    _ = jax.device_get(loss)
+    elapsed = time.perf_counter() - t0
+
+    tokens_per_step = batch * cfg.max_seq
+    tok_s = tokens_per_step * steps / elapsed
+    flops_per_token = cfg.flops_per_token()
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = _PEAK_FLOPS.get(gen, _PEAK_FLOPS["v5e"])
+    mfu = tok_s * flops_per_token / peak if on_tpu else 0.0
+    print(json.dumps({
+        "metric": "gpt2_124m_pretrain_tokens_per_sec_per_chip"
+        if on_tpu else "gpt2_scaled_cpu_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -124,7 +124,9 @@ class RemoteFunction:
 
     def _ensure_blob(self) -> Tuple[str, bytes]:
         if self._blob is None:
-            self._blob = cloudpickle.dumps(self._func)
+            from . import serialization as _ser
+
+            self._blob = _ser.dumps_code(self._func)
             self._func_id = func_id_of(self._blob)
         return self._func_id, self._blob
 
@@ -258,7 +260,9 @@ class ActorClass:
 
     def _ensure_blob(self):
         if self._blob is None:
-            self._blob = cloudpickle.dumps(self._cls)
+            from . import serialization as _ser
+
+            self._blob = _ser.dumps_code(self._cls)
             self._func_id = func_id_of(self._blob)
         return self._func_id, self._blob
 

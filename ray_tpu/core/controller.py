@@ -199,13 +199,15 @@ class Controller:
                                                                  PENDING):
                 await self._handle_actor_failure(
                     actor, f"node {node.node_id.hex()[:8]} died")
-        # Drop object locations on that node.
+        # Drop object locations on that node; delete entries with no
+        # remaining copy (locate_object must return None for them).
         gone = []
         for oid, info in self.object_dir.items():
             info["nodes"].discard(node.node_id)
             if not info["nodes"]:
                 gone.append(oid)
         for oid in gone:
+            del self.object_dir[oid]
             self._publish("object_lost", {"object_id": oid})
         if self._placement is not None:
             await self._placement.on_node_dead(node.node_id)

@@ -334,19 +334,30 @@ class NodeAgent:
             return None
         else:
             self.available = self.available.subtract(demand)
+        # Chip ids come from one host-wide ledger regardless of PG binding
+        # (bundles reserve TPU *counts*; the ids are assigned at lease
+        # time so TPU_VISIBLE_CHIPS isolation always holds).
         chip_ids: List[int] = []
         n_tpu = int(demand.get("TPU"))
-        if n_tpu > 0 and payload.get("pg_id") is None:
-            chip_ids = self.free_chips[:n_tpu]
-            self.free_chips = self.free_chips[n_tpu:]
-        w = await self._acquire_worker()
-        if w is None:
+
+        def _refund():
             if bundle is not None:
                 bundle.in_use = bundle.in_use.subtract(demand)
             else:
                 self.available = self.available.add(demand)
                 self._clamp_available()
             self.free_chips.extend(chip_ids)
+
+        if n_tpu > 0:
+            if len(self.free_chips) < n_tpu:
+                chip_ids = []
+                _refund()
+                return None  # chips pinned by blocked leases; stay queued
+            chip_ids = self.free_chips[:n_tpu]
+            self.free_chips = self.free_chips[n_tpu:]
+        w = await self._acquire_worker()
+        if w is None:
+            _refund()
             return None
         lease = Lease(
             lease_id=next(self._lease_counter), resources=demand, worker=w,
@@ -443,7 +454,13 @@ class NodeAgent:
                 bundle.in_use = bundle.in_use.subtract(lease.resources)
             except ValueError:
                 bundle.in_use = ResourceSet()
-        elif not lease.blocked:
+        elif lease.blocked:
+            # CPU was already re-credited at block time; return the rest.
+            rest = lease.resources.subtract(
+                self._blockable_part(lease.resources))
+            self.available = self.available.add(rest)
+            self._clamp_available()
+        else:
             self.available = self.available.add(lease.resources)
             self._clamp_available()
         self.free_chips.extend(lease.chip_ids)
@@ -475,6 +492,13 @@ class NodeAgent:
                 "worker_addr": lease.worker.addr}
 
     # -------------------------------------------- blocked-worker CPU credit
+    @staticmethod
+    def _blockable_part(resources: ResourceSet) -> ResourceSet:
+        """Only CPU is released while blocked in get() — accelerators stay
+        assigned (their chips are still mapped into the worker), matching
+        the reference releasing only CPU for blocked workers."""
+        return ResourceSet({"CPU": resources.get("CPU")})
+
     async def task_blocked(self, p):
         """A worker blocked in get(): return its CPU so nested tasks can
         schedule (ref: the reference releases CPU for blocked workers in
@@ -483,7 +507,8 @@ class NodeAgent:
         if lease is not None and not lease.blocked:
             lease.blocked = True
             if lease.pg_id is None:
-                self.available = self.available.add(lease.resources)
+                self.available = self.available.add(
+                    self._blockable_part(lease.resources))
                 self._clamp_available()
             self._kick_scheduler()
         return {"ok": True}
@@ -494,13 +519,10 @@ class NodeAgent:
             lease.blocked = False
             if lease.pg_id is None:
                 # May oversubscribe briefly; clamped in heartbeat view.
-                try:
-                    self.available = self.available.subtract(lease.resources)
-                except ValueError:
-                    self.available = ResourceSet({
-                        k: self.available.get(k) - v
-                        for k, v in lease.resources.amounts.items()
-                        if True})
+                part = self._blockable_part(lease.resources)
+                self.available = ResourceSet({
+                    **self.available.amounts,
+                    "CPU": self.available.get("CPU") - part.get("CPU")})
         return {"ok": True}
 
     # -------------------------------------------------------- object plane
@@ -611,11 +633,17 @@ class NodeAgent:
 
     # -------------------------------------------------- placement bundles
     async def prepare_bundle(self, p):
+        key = (p["pg_id"], p["bundle_index"])
+        existing = self.bundles.get(key)
+        if existing is not None:
+            # Re-prepare of a bundle we still hold (controller retry /
+            # reschedule): keep the reservation, don't double-subtract.
+            return {"ok": True}
         demand = ResourceSet(dict(p["resources"]))
         if not self.available.covers(demand):
             return {"ok": False}
         self.available = self.available.subtract(demand)
-        self.bundles[(p["pg_id"], p["bundle_index"])] = _Bundle(
+        self.bundles[key] = _Bundle(
             pg_id=p["pg_id"], bundle_index=p["bundle_index"],
             resources=demand)
         return {"ok": True}
@@ -645,7 +673,16 @@ class NodeAgent:
             "actor_id": spec.actor_id, "pg_id": None})
         if granted is None:
             return {"ok": False}
-        w = self.workers.get(granted["worker_id"])
+
+        def _undo():
+            lease = self.leases.get(granted["lease_id"])
+            if lease is not None:
+                # Flip back to 'leased' so release re-queues the worker.
+                if lease.worker.state == "actor":
+                    lease.worker.state = "leased"
+                    lease.worker.actor_id = None
+                self._release_lease(lease)
+
         cli = RpcClient(granted["worker_addr"], tag="agent-restart")
         try:
             await cli.connect()
@@ -654,15 +691,11 @@ class NodeAgent:
                 "lease_id": granted["lease_id"], "is_restart": True})
             await cli.close()
             if not r.get("ok"):
-                if w is not None:
-                    w.state = "idle"
-                    w.actor_id = None
-                lease = self.leases.get(granted["lease_id"])
-                if lease:
-                    self._release_lease(lease)
+                _undo()
                 return {"ok": False}
             return {"ok": True}
         except RpcError:
+            _undo()
             return {"ok": False}
 
     async def report_actor_failure(self, p):

@@ -74,16 +74,14 @@ class PlacementGroupManager:
             # Try to place everything on a single node first.
             for n in nodes:
                 trial = dict(avail[n.node_id])
-                if all(_fits(trial, entry.bundles[i]) or True for i in order):
-                    ok = True
-                    t2 = dict(avail[n.node_id])
-                    for i in order:
-                        if not _fits(t2, entry.bundles[i]):
-                            ok = False
-                            break
-                        _sub(t2, entry.bundles[i])
-                    if ok:
-                        return {i: n.node_id for i in order}
+                ok = True
+                for i in order:
+                    if not _fits(trial, entry.bundles[i]):
+                        ok = False
+                        break
+                    _sub(trial, entry.bundles[i])
+                if ok:
+                    return {i: n.node_id for i in order}
             if strategy == "STRICT_PACK":
                 return None
             # Soft PACK: greedy fill, spill to other nodes.
@@ -229,6 +227,18 @@ class PlacementGroupManager:
         for entry in self._groups.values():
             if entry.state == CREATED and node_id in entry.placement.values():
                 entry.state = RESCHEDULING
+                # Return the bundles still held by SURVIVING nodes before
+                # re-planning, or their reservations leak forever.
+                for idx, nid in list(entry.placement.items()):
+                    if nid == node_id:
+                        continue
+                    cli = await self._ctl._agent(nid)
+                    if cli is not None:
+                        try:
+                            await cli.call("return_bundle", {
+                                "pg_id": entry.pg_id, "bundle_index": idx})
+                        except RpcError:
+                            pass
                 entry.placement = {}
                 self._ctl._publish("placement_group",
                                    {"pg_id": entry.pg_id,

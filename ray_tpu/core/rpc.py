@@ -47,7 +47,7 @@ class RemoteCallError(Exception):
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> Tuple:
-    header = await reader.readexactly(4)
+    header = await reader.readexactly(8)
     n = int.from_bytes(header, "little")
     if n > _MAX_FRAME:
         raise RpcError(f"frame too large: {n}")
@@ -56,8 +56,9 @@ async def _read_frame(reader: asyncio.StreamReader) -> Tuple:
 
 
 def _encode_frame(msg: Tuple) -> bytes:
+    # 8-byte length prefix: object-transfer frames can exceed 4 GiB.
     data = cloudpickle.dumps(msg, protocol=5)
-    return len(data).to_bytes(4, "little") + data
+    return len(data).to_bytes(8, "little") + data
 
 
 class RpcServer:
@@ -333,5 +334,10 @@ class EventLoopThread:
         try:
             self.loop.call_soon_threadsafe(_shutdown)
             self._thread.join(timeout=5)
+        except Exception:
+            pass
+        try:
+            if not self._thread.is_alive():
+                self.loop.close()
         except Exception:
             pass

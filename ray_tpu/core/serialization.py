@@ -92,6 +92,43 @@ def unpack(data) -> Any:
     return pickle.loads(payload, buffers=buffers)
 
 
+_by_value_registered = set()
+
+
+def ensure_code_portable(obj: Any) -> None:
+    """Make ``obj``'s defining module pickle BY VALUE when worker
+    processes can't import it (driver scripts, test modules).  Installed
+    site/dist packages and this framework stay by-reference — the
+    equivalent of the reference shipping user code via the function
+    table + working_dir runtime env rather than expecting importability
+    (ref: python/ray/_private/function_manager.py)."""
+    import sys
+
+    mod_name = getattr(obj, "__module__", None)
+    if (not mod_name or mod_name == "__main__"
+            or mod_name in _by_value_registered
+            or mod_name.split(".")[0] in ("ray_tpu", "builtins")
+            or mod_name.split(".")[0] in sys.stdlib_module_names):
+        return
+    mod = sys.modules.get(mod_name)
+    if mod is None:
+        return
+    file = getattr(mod, "__file__", "") or ""
+    if "site-packages" in file or "dist-packages" in file or not file:
+        return
+    try:
+        cloudpickle.register_pickle_by_value(mod)
+        _by_value_registered.add(mod_name)
+    except Exception:
+        pass
+
+
+def dumps_code(obj: Any) -> bytes:
+    """cloudpickle for code objects shipped to workers."""
+    ensure_code_portable(obj)
+    return cloudpickle.dumps(obj, protocol=5)
+
+
 def dumps_message(msg: Any) -> bytes:
     """Control-plane message serialization (small, no out-of-band)."""
     return cloudpickle.dumps(msg, protocol=5)

@@ -259,16 +259,22 @@ class Worker:
             self.actor_executor, self._execute_sync, spec, method, None, [])
 
     async def _run_async_method(self, spec: TaskSpec, method) -> TaskResult:
-        self.runtime.set_current_task(spec.task_id)
+        # NOTE: no set_current_task here — the task context is a
+        # thread-local shared by every coroutine on this loop, and
+        # concurrent async methods would cross-contaminate it (object
+        # IDs stay unique regardless: the put counter is process-global).
+        loop = asyncio.get_event_loop()
         try:
-            pos, kwargs = self._resolve_args(spec)
+            # Arg resolution may block on remote objects; keep it off the
+            # event loop so other handlers stay live.
+            pos, kwargs = await loop.run_in_executor(
+                self._task_executor, self._resolve_args, spec)
             result = await method(*pos, **kwargs)
-            return self._package_returns(spec, result)
+            return await loop.run_in_executor(
+                self._task_executor, self._package_returns, spec, result)
         except BaseException as e:  # noqa: BLE001
             return TaskResult(task_id=spec.task_id, ok=False,
                               error=ActorError.from_exception(e))
-        finally:
-            self.runtime.set_current_task(None)
 
     # --------------------------------------------------------------- admin
     async def ping(self, _p):

@@ -1,0 +1,11 @@
+"""ray_tpu.models — TPU-first reference model families.
+
+Flagships used by the train stack and benchmarks: GPT-2 (pretrain
+baseline, BASELINE.json headline metric) and Llama (RoPE/GQA/SwiGLU
+family).  All models are flax.linen with *logical* dimension names
+threaded through ray_tpu.parallel.sharding rules, so DP/FSDP/TP/CP
+layouts are a rules-table choice, not a model edit.
+"""
+
+from .gpt2 import GPT2, GPT2Config, gpt2_loss_fn, gpt2_param_axes  # noqa
+from .llama import Llama, LlamaConfig, llama_loss_fn, llama_param_axes  # noqa

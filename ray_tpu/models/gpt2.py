@@ -1,0 +1,202 @@
+"""GPT-2 — the pretraining flagship (BASELINE.json: tokens/sec/chip).
+
+TPU-first design notes:
+- bfloat16 activations/params with fp32 master-less optics (optax handles
+  fp32 moments), matmuls hit the MXU with preferred_element_type fp32;
+- every weight/activation dim carries a logical name consumed by
+  ray_tpu.parallel.sharding rules (DP/FSDP/TP = table change);
+- attention impl selectable: "dense" (XLA-fused, GSPMD-partitioned),
+  "ring" (context parallel over the ``seq`` mesh axis, SURVEY.md §5.7),
+  or "ulysses" (head/seq all-to-all);
+- jax.checkpoint per block when ``remat`` so long-context activation
+  memory trades against recompute.
+
+Role-equivalent to the reference's GPT-2 release-test workloads (ref:
+release/train_tests LLM configs; the reference trains them via
+torch+DeepSpeed, here the model is native).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import ShardingRules, with_logical_constraint
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_seq: int = 1024
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "dense"          # dense | ring | ulysses
+    remat: bool = True
+    mesh: Any = None                  # jax Mesh for CP shard_map wrappers
+    rules: Any = None                 # ShardingRules override
+
+    @staticmethod
+    def small() -> "GPT2Config":
+        return GPT2Config()
+
+    @staticmethod
+    def tiny() -> "GPT2Config":
+        return GPT2Config(vocab_size=512, n_layer=2, n_head=4, d_model=128,
+                          d_ff=512, max_seq=128)
+
+    @staticmethod
+    def medium() -> "GPT2Config":
+        return GPT2Config(n_layer=24, n_head=16, d_model=1024, d_ff=4096)
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs per token (fwd+bwd ≈ 6N + attn)."""
+        n_params = (self.vocab_size * self.d_model
+                    + self.max_seq * self.d_model
+                    + self.n_layer * (4 * self.d_model ** 2
+                                      + 2 * self.d_model * self.d_ff))
+        attn = 6 * 2 * self.n_layer * self.d_model * self.max_seq
+        return 6.0 * n_params + attn
+
+
+def _constrain(x, logical, cfg: GPT2Config):
+    rules = cfg.rules or ShardingRules()
+    if cfg.mesh is None:
+        return x
+    return with_logical_constraint(x, logical, cfg.mesh, rules)
+
+
+def _attention(cfg: GPT2Config, q, k, v):
+    """q,k,v: [B, T, H, D] -> [B, T, H, D]."""
+    if cfg.attn_impl == "dense":
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores * (q.shape[-1] ** -0.5)
+        t = q.shape[1]
+        mask = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0) >= \
+            jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.ring_attention import ring_attention
+    from ..parallel.ulysses import ulysses_attention
+
+    if cfg.mesh is None:
+        raise ValueError(f"attn_impl={cfg.attn_impl!r} needs cfg.mesh")
+    inner = (ring_attention if cfg.attn_impl == "ring"
+             else ulysses_attention)
+    spec = P(("data", "fsdp"), "seq", None, None)
+    fn = shard_map(functools.partial(inner, causal=True),
+                   mesh=cfg.mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = cfg.n_head
+        d_head = cfg.d_model // h
+        y = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
+        qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype, name="c_attn",
+                       kernel_init=nn.initializers.normal(0.02))(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, t = q.shape[0], q.shape[1]
+        q = q.reshape(b, t, h, d_head)
+        k = k.reshape(b, t, h, d_head)
+        v = v.reshape(b, t, h, d_head)
+        q = _constrain(q, ("batch", "seq", "heads", None), cfg)
+        k = _constrain(k, ("batch", "seq", "heads", None), cfg)
+        v = _constrain(v, ("batch", "seq", "heads", None), cfg)
+        att = _attention(cfg, q, k, v).reshape(b, t, cfg.d_model)
+        att = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="c_proj",
+                       kernel_init=nn.initializers.normal(
+                           0.02 / (2 * cfg.n_layer) ** 0.5))(att)
+        x = x + att
+        y = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="mlp_in",
+                     kernel_init=nn.initializers.normal(0.02))(y)
+        y = _constrain(y, ("batch", "seq", "mlp"), cfg)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlp_out",
+                     kernel_init=nn.initializers.normal(
+                         0.02 / (2 * cfg.n_layer) ** 0.5))(y)
+        return x + y
+
+
+class GPT2(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.d_model), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.max_seq, cfg.d_model), jnp.float32)
+        t = tokens.shape[1]
+        x = wte.astype(cfg.dtype)[tokens] + wpe.astype(cfg.dtype)[:t]
+        x = _constrain(x, ("batch", "seq", "embed"), cfg)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False)
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"h_{i}")(x)
+            x = _constrain(x, ("batch", "seq", "embed"), cfg)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = jnp.einsum("btd,vd->btv", x, wte.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        return _constrain(logits, ("batch", "seq", "vocab"), cfg)
+
+
+def gpt2_init(cfg: GPT2Config, rng) -> Any:
+    import dataclasses
+
+    # Init traces a tiny batch; sharding constraints (and CP shard_map)
+    # don't apply to it and would reject the shapes — strip them.
+    init_cfg = dataclasses.replace(cfg, mesh=None, attn_impl="dense")
+    tokens = jnp.zeros((1, min(cfg.max_seq, 8)), jnp.int32)
+    return GPT2(init_cfg).init(rng, tokens)
+
+
+def gpt2_loss_fn(cfg: GPT2Config, params, batch) -> jnp.ndarray:
+    """Next-token cross entropy; batch: {tokens [B, T+1] int32}."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = GPT2(cfg).apply(params, inputs)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def gpt2_param_axes(path: str, leaf) -> Tuple[Optional[str], ...]:
+    """Logical axes per parameter path for shard_pytree (DP/FSDP/TP)."""
+    if "wte" in path:
+        return ("vocab", "embed_fsdp")
+    if "wpe" in path:
+        return (None, None)
+    if leaf.ndim == 1:
+        return (None,)
+    if "c_attn" in path:
+        return ("embed_fsdp", "heads")
+    if "c_proj" in path:
+        return ("heads", "embed_fsdp")
+    if "mlp_in" in path:
+        return ("embed_fsdp", "mlp")
+    if "mlp_out" in path:
+        return ("mlp", "embed_fsdp")
+    return (None,) * leaf.ndim

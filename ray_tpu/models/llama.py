@@ -1,0 +1,225 @@
+"""Llama family — RMSNorm + RoPE + GQA + SwiGLU decoder.
+
+Covers the reference's Llama fine-tune workloads (ref: release/train_tests
+LLM configs) natively.  Same logical-axis discipline as gpt2.py; grouped
+KV heads carry the "kv" logical name so TP over ``tensor`` can shard
+query heads while replicating (or sharding) KV heads independently.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import ShardingRules, with_logical_constraint
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    n_layer: int = 8
+    n_head: int = 8
+    n_kv_head: int = 4
+    d_model: int = 512
+    d_ff: int = 1408
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "dense"
+    remat: bool = True
+    mesh: Any = None
+    rules: Any = None
+
+    @staticmethod
+    def tiny() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=512, n_layer=2, n_head=4, n_kv_head=2,
+                           d_model=128, d_ff=384, max_seq=128)
+
+    @staticmethod
+    def llama2_7b() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=32000, n_layer=32, n_head=32,
+                           n_kv_head=32, d_model=4096, d_ff=11008,
+                           max_seq=4096)
+
+    def flops_per_token(self) -> float:
+        head_dim = self.d_model // self.n_head
+        n_params = (self.vocab_size * self.d_model * 2
+                    + self.n_layer * (
+                        self.d_model * self.d_model            # q
+                        + 2 * self.d_model * self.n_kv_head * head_dim
+                        + self.d_model * self.d_model          # o
+                        + 3 * self.d_model * self.d_ff))
+        attn = 6 * 2 * self.n_layer * self.d_model * self.max_seq
+        return 6.0 * n_params + attn
+
+
+def _constrain(x, logical, cfg):
+    if cfg.mesh is None:
+        return x
+    return with_logical_constraint(x, logical, cfg.mesh,
+                                   cfg.rules or ShardingRules())
+
+
+def _rope(x, theta: float):
+    """Rotary embedding over [B, T, H, D] (D even)."""
+    b, t, h, d = x.shape
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(self.dtype)
+
+
+def _attention(cfg, q, k, v):
+    if cfg.attn_impl == "dense":
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores * (q.shape[-1] ** -0.5)
+        t = q.shape[1]
+        mask = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0) >= \
+            jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.ring_attention import ring_attention
+    from ..parallel.ulysses import ulysses_attention
+
+    if cfg.mesh is None:
+        raise ValueError(f"attn_impl={cfg.attn_impl!r} needs cfg.mesh")
+    inner = (ring_attention if cfg.attn_impl == "ring"
+             else ulysses_attention)
+    spec = P(("data", "fsdp"), "seq", None, None)
+    fn = shard_map(functools.partial(inner, causal=True),
+                   mesh=cfg.mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h, hk = cfg.n_head, cfg.n_kv_head
+        d_head = cfg.d_model // h
+        b, t = x.shape[0], x.shape[1]
+        y = RMSNorm(cfg.rms_eps, cfg.dtype, name="attn_norm")(x)
+        init = nn.initializers.normal(0.02)
+        q = nn.Dense(h * d_head, use_bias=False, dtype=cfg.dtype,
+                     kernel_init=init, name="wq")(y).reshape(b, t, h, d_head)
+        k = nn.Dense(hk * d_head, use_bias=False, dtype=cfg.dtype,
+                     kernel_init=init, name="wk")(y).reshape(b, t, hk,
+                                                             d_head)
+        v = nn.Dense(hk * d_head, use_bias=False, dtype=cfg.dtype,
+                     kernel_init=init, name="wv")(y).reshape(b, t, hk,
+                                                             d_head)
+        q = _rope(q, cfg.rope_theta)
+        k = _rope(k, cfg.rope_theta)
+        if hk != h:  # GQA: repeat KV groups to full heads
+            rep = h // hk
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        q = _constrain(q, ("batch", "seq", "heads", None), cfg)
+        k = _constrain(k, ("batch", "seq", "heads", None), cfg)
+        v = _constrain(v, ("batch", "seq", "heads", None), cfg)
+        att = _attention(cfg, q, k, v).reshape(b, t, cfg.d_model)
+        att = nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                       kernel_init=init, name="wo")(att)
+        x = x + att
+        y = RMSNorm(cfg.rms_eps, cfg.dtype, name="mlp_norm")(x)
+        gate = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                        kernel_init=init, name="w_gate")(y)
+        up = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                      kernel_init=init, name="w_up")(y)
+        z = nn.silu(gate) * up
+        z = _constrain(z, ("batch", "seq", "mlp"), cfg)
+        down = nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                        kernel_init=init, name="w_down")(z)
+        return x + down
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        emb = self.param("embed", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.d_model), jnp.float32)
+        x = emb.astype(cfg.dtype)[tokens]
+        x = _constrain(x, ("batch", "seq", "embed"), cfg)
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(LlamaBlock, prevent_cse=False)
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"layer_{i}")(x)
+            x = _constrain(x, ("batch", "seq", "embed"), cfg)
+        x = RMSNorm(cfg.rms_eps, cfg.dtype, name="norm_f")(x)
+        head = self.param("lm_head", nn.initializers.normal(0.02),
+                          (cfg.d_model, cfg.vocab_size), jnp.float32)
+        logits = jnp.einsum("btd,dv->btv", x, head.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        return _constrain(logits, ("batch", "seq", "vocab"), cfg)
+
+
+def llama_init(cfg: LlamaConfig, rng):
+    import dataclasses
+
+    init_cfg = dataclasses.replace(cfg, mesh=None, attn_impl="dense")
+    tokens = jnp.zeros((1, min(cfg.max_seq, 8)), jnp.int32)
+    return Llama(init_cfg).init(rng, tokens)
+
+
+def llama_loss_fn(cfg: LlamaConfig, params, batch):
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = Llama(cfg).apply(params, inputs)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def llama_param_axes(path: str, leaf) -> Tuple[Optional[str], ...]:
+    if "embed" in path and leaf.ndim == 2:
+        return ("vocab", "embed_fsdp")
+    if "lm_head" in path:
+        return ("embed_fsdp", "vocab")
+    if leaf.ndim == 1:
+        return (None,)
+    if any(k in path for k in ("wq", "wk", "wv")):
+        return ("embed_fsdp", "heads")
+    if "wo" in path:
+        return ("heads", "embed_fsdp")
+    if any(k in path for k in ("w_gate", "w_up")):
+        return ("embed_fsdp", "mlp")
+    if "w_down" in path:
+        return ("mlp", "embed_fsdp")
+    return (None,) * leaf.ndim

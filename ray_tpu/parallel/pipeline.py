@@ -1,0 +1,90 @@
+"""Pipeline parallelism — GPipe microbatch rotation over the mesh.
+
+Fills the reference's PP gap (SURVEY.md §2.3: absent as a training
+feature; its compiled-DAG actor pipelines are a building block, not a
+trainer).  TPU-native shape: every pipeline stage lives on one slice of
+the ``pipeline`` mesh axis, stage parameters are stacked on a leading
+stage dim sharded over that axis, and a lax.scan rotates activations to
+the next stage with ppermute each tick.  Bubble fraction is the usual
+(S-1)/(M+S-1); autodiff through the scan yields 1F1B-ish memory with
+jax.checkpoint on the stage fn.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, *,
+                   num_microbatches: int, axis_name: str = "pipeline",
+                   checkpoint_stage: bool = True):
+    """Run a pipeline of S stages over a batch, inside shard_map.
+
+    stage_fn(params_for_stage, activation) -> activation (same shape!)
+    stage_params: pytree whose leaves have the *local* stage's values
+        (shard_map already sliced the stacked [S, ...] leaves).
+    x: local full-batch input [batch, ...] — every stage receives the
+        same x operand, only stage 0 actually consumes it.
+    Returns activations after the last stage, valid on every device
+    (masked psum broadcast), shape [batch, ...].
+    """
+    s = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    # shard_map slices the stacked [S, ...] leaves to [1, ...] locally;
+    # strip that stage dim so stage_fn sees clean per-stage params.
+    stage_params = jax.tree_util.tree_map(
+        lambda a: jax.lax.squeeze(a, (0,)), stage_params)
+    m = num_microbatches
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by microbatches {m}")
+    mb = b // m
+    micro = x.reshape((m, mb) + x.shape[1:])
+
+    fn = stage_fn
+    if checkpoint_stage:
+        fn = jax.checkpoint(stage_fn)
+
+    perm_fwd = [(j, (j + 1) % s) for j in range(s)]
+    total = m + s - 1
+
+    def tick(carry, t):
+        acts, outputs = carry
+        # Stage 0 injects microbatch t (while valid); others use the
+        # activation received on the previous tick.
+        mb_idx = jnp.clip(t, 0, m - 1)
+        inject = jax.lax.dynamic_index_in_dim(micro, mb_idx, 0,
+                                              keepdims=False)
+        inp = jnp.where(stage == 0, inject, acts)
+        out = fn(stage_params, inp)
+        # Last stage records its result at position t-(s-1) when valid.
+        out_idx = jnp.clip(t - (s - 1), 0, m - 1)
+        is_valid = jnp.logical_and(stage == s - 1, t >= s - 1)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outputs, out.astype(outputs.dtype), out_idx, 0)
+        outputs = jnp.where(is_valid, updated, outputs)
+        # Rotate activations to the next stage.
+        acts = jax.lax.ppermute(out, axis_name, perm_fwd)
+        return (acts, outputs), None
+
+    acts0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+    outputs0 = jnp.zeros((m, mb) + x.shape[1:], x.dtype)
+    (_, outputs), _ = jax.lax.scan(tick, (acts0, outputs0),
+                                   jnp.arange(total))
+    # Broadcast the last stage's outputs to all stages so downstream
+    # (loss on every data-parallel replica) sees them.
+    outputs = jax.lax.psum(
+        jnp.where(stage == s - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name)
+    return outputs.reshape((b,) + x.shape[1:])
+
+
+def stack_stage_params(params_per_stage):
+    """Stack a list of per-stage pytrees into one pytree with a leading
+    stage dim (shard it over the ``pipeline`` axis)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *params_per_stage)

@@ -1,0 +1,114 @@
+"""Ring attention — context parallelism over the ICI ring.
+
+Fills the reference's sequence-parallel gap (SURVEY.md §5.7: absent
+upstream, first-class here).  Sequence is sharded over the ``seq`` mesh
+axis; K/V blocks rotate around the ring via ppermute while each device
+accumulates online-softmax partial attention for its resident Q block —
+blockwise attention in the ring-attention style (Liu et al.), expressed
+as a lax.scan inside shard_map so XLA overlaps the permute with compute.
+
+Differentiable by construction (autodiff through scan + ppermute; the
+transpose of ppermute is the reverse rotation), with jax.checkpoint on
+the per-step body so activation memory stays O(seq_local) per device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_off, kv_off, causal, scale):
+    """One (Q_local x KV_block) online-softmax partial.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D].  Returns (num, den, m) partials
+    in fp32: num [B,Tq,H,D], den [B,Tq,H], m [B,Tq,H].
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        q_idx = q_off + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        kv_idx = kv_off + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        mask = q_idx >= kv_idx
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1)                      # [B,H,Tq]
+    p = jnp.exp(scores - m[..., None])
+    p = jnp.where(m[..., None] <= _NEG_INF / 2, 0.0, p)
+    den = jnp.sum(p, axis=-1)                          # [B,H,Tq]
+    num = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    # Rearrange to [B,Tq,H,...]
+    return num, den.transpose(0, 2, 1), m.transpose(0, 2, 1)
+
+
+def _merge(num, den, m, num2, den2, m2):
+    m_new = jnp.maximum(m, m2)
+    a1 = jnp.exp(m - m_new)
+    a2 = jnp.exp(m2 - m_new)
+    num = num * a1[..., None] + num2 * a2[..., None]
+    den = den * a1 + den2 * a2
+    return num, den, m_new
+
+
+def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
+                   scale: Optional[float] = None,
+                   checkpoint_steps: bool = True):
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Must be called inside shard_map (or pmap) with q/k/v local shards of
+    shape [batch, seq_local, heads, head_dim].  Returns the local output
+    shard, same shape/dtype as q.
+    """
+    n = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    q32 = q.astype(jnp.float32)
+
+    def step(carry, i):
+        kv, num, den, m = carry
+        k_blk, v_blk = kv
+        src = (rank - i) % n      # whose block we currently hold
+        num2, den2, m2 = _block_attn(
+            q32, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32),
+            q_off=rank * t_local, kv_off=src * t_local,
+            causal=causal, scale=scale)
+        num, den, m = _merge(num, den, m, num2, den2, m2)
+        # Rotate K/V to the next device (i -> i+1 around the ring).
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kv = jax.tree_util.tree_map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm), (k_blk, v_blk))
+        return (kv, num, den, m), None
+
+    if checkpoint_steps:
+        step = jax.checkpoint(step)
+
+    num0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+    den0 = jnp.zeros((b, t_local, h), jnp.float32)
+    m0 = jnp.full((b, t_local, h), _NEG_INF, jnp.float32)
+    (_, num, den, m), _ = jax.lax.scan(
+        step, ((k, v), num0, den0, m0), jnp.arange(n))
+    den = jnp.where(den == 0.0, 1.0, den)
+    out = num / den[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(mesh, q, k, v, *, causal: bool = True,
+                           rules=None):
+    """Convenience wrapper: runs ring_attention under shard_map on
+    ``mesh`` with batch over (data, fsdp) and sequence over ``seq``."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(("data", "fsdp"), "seq", "tensor", None)
+    fn = shard_map(
+        functools.partial(ring_attention, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

@@ -1,0 +1,61 @@
+"""Ulysses-style sequence parallelism: head/sequence all-to-all.
+
+The second context-parallel scheme (SURVEY.md §5.7 gap): instead of
+rotating K/V around a ring, switch the sharding of the attention inputs
+from sequence-sharded to head-sharded with one all-to-all, run full-
+sequence attention on 1/N of the heads locally, and switch back.  Best
+when heads >= ring size and the all-to-all rides ICI; composes with ring
+attention (Ulysses within a host, ring across hosts).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _default_attn(q, k, v, causal: bool):
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * d ** -0.5
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        mask = jax.lax.broadcasted_iota(jnp.int32, (t_q, t_k), 0) >= \
+            jax.lax.broadcasted_iota(jnp.int32, (t_q, t_k), 1)
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(
+        q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "seq",
+                      causal: bool = True,
+                      attn_fn: Optional[Callable] = None):
+    """Call inside shard_map with q/k/v sequence-sharded
+    [batch, seq_local, heads, head_dim]; heads must divide the axis size.
+
+    all_to_all #1: seq-sharded -> head-sharded (full sequence locally)
+    local attention over the full sequence on heads/N heads
+    all_to_all #2: head-sharded -> seq-sharded
+    """
+    n = jax.lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(f"heads ({h}) must be divisible by the seq axis "
+                         f"size ({n}) for Ulysses; use ring attention")
+    attn = attn_fn or _default_attn
+
+    def to_heads(x):
+        # [B, Tl, H, D] -> [B, Tl*N, H/N, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    def to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    qg, kg, vg = to_heads(q), to_heads(k), to_heads(v)
+    out = attn(qg, kg, vg, causal)
+    return to_seq(out)

@@ -1,0 +1,145 @@
+"""Training backends — per-framework worker-group bootstrap hooks.
+
+Role-equivalent to the reference's Backend classes (ref:
+train/_internal/backend_executor.py + train/torch/config.py TCP-store
+rendezvous, train/tensorflow/config.py TF_CONFIG).  The TPU-native
+flagship is JaxBackend: worker 0 publishes a coordinator address through
+the controller KV (the named-rendezvous pattern) and every worker calls
+jax.distributed.initialize, after which the global device view spans the
+gang and meshes from ray_tpu.parallel cover every chip.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Dict, List
+
+import ray_tpu
+
+
+class Backend:
+    """Subclass per framework; hooks run at group start/shutdown."""
+
+    def on_start(self, worker_group, run_id: str) -> None:
+        pass
+
+    def on_shutdown(self, worker_group) -> None:
+        pass
+
+
+class JaxBackend(Backend):
+    def on_start(self, worker_group, run_id: str) -> None:
+        num = worker_group.num_workers
+        if num == 1:
+            return  # single-process jax needs no distributed init
+
+        def _bootstrap(rank: int, world: int, key: str):
+            import ray_tpu
+            from ray_tpu.core import runtime as _rt
+
+            rt = _rt.get_runtime()
+            if rank == 0:
+                s = socket.socket()
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+                s.close()
+                coord = f"127.0.0.1:{port}"
+                rt.controller_call("kv_put", {"key": key,
+                                              "value": coord.encode()})
+            else:
+                import time
+
+                deadline = time.time() + 120
+                coord = None
+                while time.time() < deadline:
+                    raw = rt.controller_call("kv_get", {"key": key})
+                    if raw:
+                        coord = raw.decode()
+                        break
+                    time.sleep(0.05)
+                if coord is None:
+                    raise TimeoutError("jax coordinator never published")
+            import jax
+
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=world,
+                                       process_id=rank)
+            return len(jax.devices())
+
+        key = f"train/{run_id}/jax_coordinator"
+        refs = []
+        for w in worker_group.workers:
+            from ..core import serialization
+
+            payload = serialization.dumps_code(_bootstrap)
+            refs.append(w.actor.run.remote(payload,
+                                           (w.rank, num, key), {}))
+        ray_tpu.get(refs, timeout=300)
+
+    def on_shutdown(self, worker_group) -> None:
+        def _teardown():
+            try:
+                import jax
+
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            return True
+
+        try:
+            worker_group.execute(_teardown)
+        except Exception:
+            pass
+
+
+class TorchBackend(Backend):
+    """CPU gloo process group for torch parity workloads (ref:
+    train/torch/config.py _TorchBackend)."""
+
+    def on_start(self, worker_group, run_id: str) -> None:
+        num = worker_group.num_workers
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        master = f"127.0.0.1:{port}"
+
+        def _init(rank: int, world: int, addr: str):
+            import os
+
+            host, port = addr.rsplit(":", 1)
+            os.environ["MASTER_ADDR"] = host
+            os.environ["MASTER_PORT"] = port
+            os.environ["RANK"] = str(rank)
+            os.environ["WORLD_SIZE"] = str(world)
+            import torch.distributed as dist
+
+            if not dist.is_initialized():
+                dist.init_process_group("gloo", rank=rank,
+                                        world_size=world)
+            return True
+
+        refs = []
+        from ..core import serialization
+
+        payload = serialization.dumps_code(_init)
+        for w in worker_group.workers:
+            refs.append(w.actor.run.remote(payload,
+                                           (w.rank, num, master), {}))
+        ray_tpu.get(refs, timeout=300)
+
+    def on_shutdown(self, worker_group) -> None:
+        def _teardown():
+            try:
+                import torch.distributed as dist
+
+                if dist.is_initialized():
+                    dist.destroy_process_group()
+            except Exception:
+                pass
+            return True
+
+        try:
+            worker_group.execute(_teardown)
+        except Exception:
+            pass

@@ -1,0 +1,131 @@
+"""Checkpoint — a directory handle on (fsspec-style) storage.
+
+Role-equivalent to the reference's ray.train.Checkpoint (ref:
+python/ray/train/_checkpoint.py) and the StorageContext upload/download
+plumbing (train/_internal/storage.py).  Local filesystem paths are the
+baseline; to_directory/as_directory copy or expose the payload.  Model
+state serialization for jax pytrees rides msgpack via flax.serialization
+(orbax integration is a drop-in upgrade at the call site).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or tempfile.mkdtemp(prefix="rt_ckpt_")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextmanager
+    def as_directory(self):
+        yield self.path
+
+    # -- convenience jax pytree payloads ---------------------------------
+    def save_pytree(self, name: str, tree: Any) -> None:
+        from flax import serialization
+
+        os.makedirs(self.path, exist_ok=True)
+        with open(os.path.join(self.path, name + ".msgpack"), "wb") as f:
+            f.write(serialization.to_bytes(tree))
+
+    def load_pytree(self, name: str, target: Any = None) -> Any:
+        from flax import serialization
+
+        with open(os.path.join(self.path, name + ".msgpack"), "rb") as f:
+            data = f.read()
+        if target is None:
+            return serialization.msgpack_restore(data)
+        return serialization.from_bytes(target, data)
+
+    def save_json(self, name: str, obj: Dict) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        with open(os.path.join(self.path, name + ".json"), "w") as f:
+            json.dump(obj, f)
+
+    def load_json(self, name: str) -> Dict:
+        with open(os.path.join(self.path, name + ".json")) as f:
+            return json.load(f)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+class CheckpointManager:
+    """Keeps the latest/top-k checkpoints in a run directory (ref:
+    train/_internal/checkpoint_manager.py)."""
+
+    def __init__(self, run_dir: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None,
+                 score_order: str = "max"):
+        self.run_dir = run_dir
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._entries: list = []  # (score, index, path)
+        self._index = 0
+        os.makedirs(run_dir, exist_ok=True)
+
+    def register(self, source_dir: str,
+                 metrics: Optional[Dict] = None) -> Checkpoint:
+        self._index += 1
+        dest = os.path.join(self.run_dir,
+                            f"checkpoint_{self._index:06d}")
+        if os.path.abspath(source_dir) != dest:
+            shutil.copytree(source_dir, dest, dirs_exist_ok=True)
+        score = None
+        if self.score_attribute and metrics:
+            score = metrics.get(self.score_attribute)
+        self._entries.append((score, self._index, dest))
+        self._prune()
+        return Checkpoint(dest)
+
+    def _prune(self) -> None:
+        if self.num_to_keep is None or \
+                len(self._entries) <= self.num_to_keep:
+            return
+        if self.score_attribute:
+            reverse = self.score_order == "max"
+            ranked = sorted(
+                self._entries,
+                key=lambda e: (e[0] is None,
+                               -e[0] if (reverse and e[0] is not None)
+                               else (e[0] if e[0] is not None else 0)))
+        else:
+            ranked = sorted(self._entries, key=lambda e: -e[1])
+        for _score, _idx, path in ranked[self.num_to_keep:]:
+            shutil.rmtree(path, ignore_errors=True)
+        self._entries = ranked[: self.num_to_keep]
+
+    def latest(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return None
+        latest = max(self._entries, key=lambda e: e[1])
+        return Checkpoint(latest[2])
+
+    @staticmethod
+    def find_latest_in(run_dir: str) -> Optional[Checkpoint]:
+        """Resume support: locate the newest checkpoint_* dir on disk."""
+        if not os.path.isdir(run_dir):
+            return None
+        cands = sorted(d for d in os.listdir(run_dir)
+                       if d.startswith("checkpoint_"))
+        if not cands:
+            return None
+        return Checkpoint(os.path.join(run_dir, cands[-1]))

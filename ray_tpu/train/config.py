@@ -1,0 +1,67 @@
+"""Train-stack configuration dataclasses.
+
+Role-equivalent to the reference's air config surface (ref:
+python/ray/air/config.py ScalingConfig/RunConfig/FailureConfig/
+CheckpointConfig, python/ray/train/_checkpoint.py).  TPU-era default: a
+worker is one TPU *host* (use_tpu implies chips-per-worker resources and
+STRICT_SPREAD gang placement so worker == jax process).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker:
+            return dict(self.resources_per_worker)
+        res: Dict[str, float] = {"CPU": 1.0}
+        if self.use_tpu:
+            res["TPU"] = float(os.environ.get("RT_TPU_PER_WORKER", 4))
+        return res
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        name = self.name or "train_run"
+        return os.path.join(base, name)
+
+
+@dataclass
+class Result:
+    """What fit() returns (ref: python/ray/air/result.py)."""
+
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    checkpoint: Optional[Any] = None
+    path: str = ""
+    error: Optional[BaseException] = None
+    metrics_history: list = field(default_factory=list)

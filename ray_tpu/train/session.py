@@ -1,0 +1,109 @@
+"""Per-worker training session: report/get_checkpoint/rank context.
+
+Role-equivalent to the reference's _TrainSession (ref:
+train/_internal/session.py:112, report at :672, get_checkpoint :772,
+get_dataset_shard :1098).  The session is process-global inside each
+training worker; ``report`` ships metrics (+ an optional checkpoint
+directory) to the trainer through the result-queue actor, with rank 0
+owning checkpoint persistence.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from .checkpoint import Checkpoint
+
+_session: Optional["TrainSession"] = None
+
+
+@dataclass
+class TrainSession:
+    world_rank: int
+    world_size: int
+    local_rank: int
+    local_world_size: int
+    node_rank: int
+    experiment_name: str
+    result_queue: Any = None          # ActorHandle of _ResultQueue
+    checkpoint: Optional[Checkpoint] = None
+    dataset_shards: Dict[str, Any] = field(default_factory=dict)
+    storage_dir: str = ""
+    _report_index: int = 0
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        self._report_index += 1
+        payload = {"rank": self.world_rank, "metrics": dict(metrics),
+                   "index": self._report_index,
+                   "checkpoint_path": checkpoint.path if checkpoint
+                   else None}
+        if self.result_queue is not None:
+            import ray_tpu
+
+            ray_tpu.get(self.result_queue.push.remote(payload))
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        shard = self.dataset_shards.get(name)
+        if shard is None:
+            raise KeyError(f"no dataset shard {name!r} was provided to "
+                           f"the trainer")
+        return shard
+
+
+def init_session(**kwargs) -> TrainSession:
+    global _session
+    _session = TrainSession(**kwargs)
+    return _session
+
+
+def get_session() -> TrainSession:
+    if _session is None:
+        raise RuntimeError("Not inside a training worker session")
+    return _session
+
+
+def shutdown_session() -> None:
+    global _session
+    _session = None
+
+
+# -- public functional API (ray.train.report style) -----------------------
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_session().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_session().get_dataset_shard(name)
+
+
+def get_world_rank() -> int:
+    return get_session().world_rank
+
+
+def get_world_size() -> int:
+    return get_session().world_size
+
+
+def get_local_rank() -> int:
+    return get_session().local_rank
+
+
+@contextmanager
+def checkpoint_dir():
+    """Scratch dir for building a checkpoint before report()."""
+    d = tempfile.mkdtemp(prefix="rt_ckpt_build_")
+    yield d

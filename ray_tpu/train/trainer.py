@@ -1,0 +1,207 @@
+"""Trainers: BaseTrainer / DataParallelTrainer / JaxTrainer / TorchTrainer.
+
+Role-equivalent to the reference's trainer stack (ref:
+train/base_trainer.py:111 BaseTrainer.fit, data_parallel_trainer.py:25,
+backend_executor.py:69): fit() builds a WorkerGroup (gang-placed), runs
+the backend bootstrap hook, initializes per-worker sessions, executes the
+user's train_loop_per_worker, streams session.report payloads through a
+result-queue actor, persists rank-0 checkpoints via CheckpointManager,
+and on worker failure restarts the group from the latest checkpoint up to
+FailureConfig.max_failures times.
+
+JaxTrainer is the TPU flagship (BASELINE.json north star): backend =
+jax.distributed over the gang; inside the loop workers build meshes over
+the global device view (ray_tpu.parallel) for DP/FSDP/TP/SP.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from .backend import Backend, JaxBackend, TorchBackend
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import (CheckpointConfig, FailureConfig, Result, RunConfig,
+                     ScalingConfig)
+from .worker_group import WorkerGroup, WorkerGroupError
+
+
+@ray_tpu.remote
+class _ResultQueue:
+    """Collects session.report payloads from all ranks."""
+
+    def __init__(self):
+        self.items = []
+
+    def push(self, payload):
+        self.items.append(payload)
+        return len(self.items)
+
+    def drain(self):
+        out, self.items = self.items, []
+        return out
+
+
+class BaseTrainer:
+    backend_cls = Backend
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        from ..core import serialization
+
+        # The loop rides inside task args; make its module ship by value.
+        serialization.ensure_code_portable(train_loop_per_worker)
+        self.train_loop = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    # ------------------------------------------------------------------ fit
+    def fit(self) -> Result:
+        run_dir = self.run_config.resolved_storage_path()
+        ckpt_cfg = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            run_dir, num_to_keep=ckpt_cfg.num_to_keep,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            score_order=ckpt_cfg.checkpoint_score_order)
+        start_ckpt = self.resume_from_checkpoint or \
+            CheckpointManager.find_latest_in(run_dir)
+        failures_left = self.run_config.failure_config.max_failures
+        history: list = []
+        while True:
+            try:
+                final = self._run_attempt(manager, start_ckpt, history)
+                return Result(metrics=final, checkpoint=manager.latest(),
+                              path=run_dir, metrics_history=history)
+            except WorkerGroupError as e:
+                if failures_left == 0:
+                    return Result(metrics=history[-1]["metrics"]
+                                  if history else {},
+                                  checkpoint=manager.latest(),
+                                  path=run_dir, error=e.cause,
+                                  metrics_history=history)
+                if failures_left > 0:
+                    failures_left -= 1
+                start_ckpt = manager.latest()  # elastic restart point
+
+    # -------------------------------------------------------------- attempt
+    def _run_attempt(self, manager: CheckpointManager,
+                     start_ckpt: Optional[Checkpoint],
+                     history: list) -> Dict:
+        run_id = uuid.uuid4().hex[:8]
+        sc = self.scaling_config
+        group = WorkerGroup(
+            sc.num_workers,
+            resources_per_worker=sc.worker_resources(),
+            placement_strategy=sc.placement_strategy
+            if sc.num_workers > 1 else None)
+        queue = _ResultQueue.options(
+            name=f"train_results_{run_id}").remote()
+        backend = self.backend_cls()
+        try:
+            backend.on_start(group, run_id)
+            local_infos = group.local_ranks()
+            # Shard datasets across ranks where supported.
+            shard_specs: Dict[int, Dict[str, Any]] = {
+                r: {} for r in range(sc.num_workers)}
+            for name, ds in self.datasets.items():
+                shards = self._shard_dataset(ds, sc.num_workers)
+                for r in range(sc.num_workers):
+                    shard_specs[r][name] = shards[r]
+            refs = []
+            for w, info in zip(group.workers, local_infos):
+                refs.append(group.execute_async_single(
+                    w, _worker_entry, self.train_loop,
+                    self.train_loop_config, w.rank, sc.num_workers,
+                    info, queue, start_ckpt.path if start_ckpt else None,
+                    shard_specs[w.rank],
+                    self.run_config.name or "train_run"))
+            final_metrics: Dict = {}
+            pending = list(refs)
+            while pending:
+                done, pending = ray_tpu.wait(pending, num_returns=1,
+                                             timeout=1.0)
+                self._drain(queue, manager, history)
+                for ref in done:
+                    try:
+                        ray_tpu.get(ref)
+                    except Exception as e:  # noqa: BLE001
+                        rank = refs.index(ref)
+                        raise WorkerGroupError(rank, e) from e
+            self._drain(queue, manager, history)
+            if history:
+                final_metrics = history[-1]["metrics"]
+            return final_metrics
+        finally:
+            try:
+                backend.on_shutdown(group)
+            except Exception:
+                pass
+            group.shutdown()
+            try:
+                ray_tpu.kill(queue)
+            except Exception:
+                pass
+
+    def _drain(self, queue, manager: CheckpointManager,
+               history: list) -> None:
+        for payload in ray_tpu.get(queue.drain.remote()):
+            if payload.get("checkpoint_path") and payload["rank"] == 0:
+                ckpt = manager.register(payload["checkpoint_path"],
+                                        payload["metrics"])
+                payload["checkpoint_path"] = ckpt.path
+            if payload["rank"] == 0:
+                history.append(payload)
+
+    @staticmethod
+    def _shard_dataset(ds, num_shards: int):
+        if hasattr(ds, "split"):
+            return ds.split(num_shards)
+        if hasattr(ds, "shard"):
+            return [ds.shard(num_shards, i) for i in range(num_shards)]
+        return [ds] * num_shards  # replicated (caller shards by rank)
+
+
+def _worker_entry(train_loop, config, rank, world, local_info, queue,
+                  ckpt_path, shards, experiment_name):
+    """Runs inside the worker actor: set up the session, run user code."""
+    from . import session as session_mod
+    from .checkpoint import Checkpoint
+
+    session_mod.init_session(
+        world_rank=rank, world_size=world,
+        local_rank=local_info["local_rank"],
+        local_world_size=local_info["local_world_size"],
+        node_rank=local_info["node_rank"],
+        experiment_name=experiment_name,
+        result_queue=queue,
+        checkpoint=Checkpoint(ckpt_path) if ckpt_path else None,
+        dataset_shards=shards)
+    try:
+        return train_loop(config)
+    finally:
+        session_mod.shutdown_session()
+
+
+class DataParallelTrainer(BaseTrainer):
+    backend_cls = Backend
+
+
+class JaxTrainer(DataParallelTrainer):
+    """The TPU-native trainer (north star: ref BASELINE.json — a
+    JaxTrainer in the Train stack with jax.distributed across the worker
+    group and GSPMD meshes inside the loop)."""
+
+    backend_cls = JaxBackend
+
+
+class TorchTrainer(DataParallelTrainer):
+    backend_cls = TorchBackend

@@ -9,12 +9,28 @@ python/ray/cluster_utils.py:135).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU platform with 8 virtual devices.  This image's
+# sitecustomize registers the 'axon' TPU backend when
+# PALLAS_AXON_POOL_IPS is set and pins jax_platforms=axon — clear it so
+# the env reaches child worker processes too (sitecustomize checks its
+# truthiness at interpreter start).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
         prev + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+def _force_cpu_jax():
+    # The current process may already have axon registered (sitecustomize
+    # ran before us); override the config directly.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+_force_cpu_jax()
 
 import pytest  # noqa: E402
 
