@@ -633,6 +633,8 @@ class ClusterRuntime(BaseRuntime):
                     ready.append(r)
                     not_ready.remove(r)
                     progressed = True
+                    if len(ready) >= num_returns:
+                        break  # never exceed num_returns
             if len(ready) >= num_returns:
                 break
             if deadline is not None and time.monotonic() >= deadline:

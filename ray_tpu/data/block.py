@@ -1,0 +1,109 @@
+"""Blocks — the unit of data movement and processing.
+
+Role-equivalent to the reference's Block/BlockAccessor (ref:
+python/ray/data/block.py; blocks there are Arrow tables).  A block is a
+pyarrow.Table (columnar path) or a plain list of rows (simple-object
+path); BlockAccessor normalizes both.  Blocks travel through the shared-
+memory object plane as task returns, so the Arrow path is zero-copy from
+store to consumer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+Block = Union["pyarrow.Table", List[Any]]  # noqa: F821
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self._block = block
+        self._is_arrow = type(block).__module__.startswith("pyarrow")
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        if self._is_arrow:
+            return self._block.num_rows
+        return len(self._block)
+
+    def iter_rows(self) -> Iterator[Any]:
+        if self._is_arrow:
+            for row in self._block.to_pylist():
+                yield row
+        else:
+            yield from self._block
+
+    def slice(self, start: int, end: int) -> Block:
+        if self._is_arrow:
+            return self._block.slice(start, end - start)
+        return self._block[start:end]
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        if self._is_arrow:
+            return self._block
+        rows = list(self._block)
+        if rows and isinstance(rows[0], dict):
+            return pa.Table.from_pylist(rows)
+        return pa.table({"value": rows})
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    def to_numpy_batch(self) -> Dict[str, Any]:
+        import numpy as np
+
+        if self._is_arrow:
+            return {name: np.asarray(col)
+                    for name, col in zip(self._block.column_names,
+                                         self._block.columns)}
+        rows = list(self._block)
+        if rows and isinstance(rows[0], dict):
+            keys = rows[0].keys()
+            return {k: np.asarray([r[k] for r in rows]) for k in keys}
+        return {"value": np.asarray(rows)}
+
+    def schema(self):
+        if self._is_arrow:
+            return self._block.schema
+        rows = list(self._block)
+        if rows and isinstance(rows[0], dict):
+            return {k: type(v).__name__ for k, v in rows[0].items()}
+        return type(rows[0]).__name__ if rows else None
+
+    @staticmethod
+    def batch_to_block(batch: Any) -> Block:
+        """Normalize a map_batches return (dict of arrays, pandas,
+        arrow, or list) into a block."""
+        import numpy as np
+
+        mod = type(batch).__module__
+        if mod.startswith("pyarrow"):
+            return batch
+        if mod.startswith("pandas"):
+            import pyarrow as pa
+
+            return pa.Table.from_pandas(batch, preserve_index=False)
+        if isinstance(batch, dict):
+            import pyarrow as pa
+
+            return pa.table({k: np.asarray(v) for k, v in batch.items()})
+        if isinstance(batch, list):
+            return batch
+        raise TypeError(f"unsupported batch type {type(batch)}")
+
+
+def build_block(rows: List[Any]) -> Block:
+    """Rows -> block; dict rows become Arrow, scalars stay a list."""
+    if rows and isinstance(rows[0], dict):
+        try:
+            import pyarrow as pa
+
+            return pa.Table.from_pylist(rows)
+        except Exception:
+            return rows
+    return list(rows)

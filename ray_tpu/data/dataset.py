@@ -1,0 +1,274 @@
+"""Dataset — lazy logical plans executed as task pipelines.
+
+Role-equivalent to the reference's Dataset + streaming executor (ref:
+python/ray/data/dataset.py, _internal/execution/streaming_executor.py:48).
+A Dataset is (source blocks, chain of operators); execution fans each
+block through its operator chain as remote tasks with a bounded in-flight
+window (the streaming part), materializing only at barriers
+(shuffle/split/aggregate).  TPU framing: datasets feed per-host training
+workers through split()/iter_batches(numpy) — block rows land as host
+numpy ready for device_put onto the data-parallel mesh axis.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
+                    Tuple, Union)
+
+from .block import Block, BlockAccessor, build_block
+
+
+@dataclass
+class _Op:
+    kind: str                  # map_batches | map | filter | flat_map
+    fn: Callable
+    batch_size: Optional[int] = None
+    batch_format: str = "numpy"
+
+
+def _apply_ops(block: Block, ops: List[_Op]) -> Block:
+    for op in ops:
+        acc = BlockAccessor.for_block(block)
+        if op.kind == "map":
+            block = build_block([op.fn(r) for r in acc.iter_rows()])
+        elif op.kind == "filter":
+            block = build_block([r for r in acc.iter_rows() if op.fn(r)])
+        elif op.kind == "flat_map":
+            out: List[Any] = []
+            for r in acc.iter_rows():
+                out.extend(op.fn(r))
+            block = build_block(out)
+        elif op.kind == "map_batches":
+            if op.batch_format == "numpy":
+                batch = acc.to_numpy_batch()
+            elif op.batch_format == "pandas":
+                batch = acc.to_pandas()
+            elif op.batch_format == "arrow":
+                batch = acc.to_arrow()
+            else:
+                batch = list(acc.iter_rows())
+            block = BlockAccessor.batch_to_block(op.fn(batch))
+        else:
+            raise ValueError(op.kind)
+    return block
+
+
+def _process_block(source: Callable, ops: List[_Op]) -> Block:
+    """Remote task body: materialize a source block, run its chain."""
+    return _apply_ops(source(), ops)
+
+
+class Dataset:
+    """Lazy, immutable; transformations return new Datasets."""
+
+    def __init__(self, sources: List[Callable[[], Block]],
+                 ops: Optional[List[_Op]] = None,
+                 parallel_window: int = 4):
+        self._sources = sources
+        self._ops = list(ops or [])
+        self._window = parallel_window
+        self._materialized: Optional[List[Block]] = None
+
+    # --------------------------------------------------------- transforms
+    def _with_op(self, op: _Op) -> "Dataset":
+        return Dataset(self._sources, self._ops + [op], self._window)
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return self._with_op(_Op("map", fn))
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return self._with_op(_Op("filter", fn))
+
+    def flat_map(self, fn: Callable[[Any], Iterable[Any]]) -> "Dataset":
+        return self._with_op(_Op("flat_map", fn))
+
+    def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    batch_size: Optional[int] = None) -> "Dataset":
+        return self._with_op(_Op("map_batches", fn, batch_size,
+                                 batch_format))
+
+    # ---------------------------------------------------------- execution
+    def num_blocks(self) -> int:
+        return len(self._sources)
+
+    def _execute_refs(self) -> Iterator[Any]:
+        """Stream block refs with a bounded in-flight window."""
+        import ray_tpu
+        from ..core import runtime as _rt
+        from ..core import serialization
+
+        if self._materialized is not None:
+            for b in self._materialized:
+                yield ("value", b)
+            return
+        for op in self._ops:
+            serialization.ensure_code_portable(op.fn)
+        remote_fn = ray_tpu.remote(_process_block)
+        inflight: List[Any] = []
+        pending = list(self._sources)
+        # Submit with a bounded window but yield in SOURCE order (head of
+        # line) so row order is deterministic.
+        while pending or inflight:
+            while pending and len(inflight) < self._window:
+                src = pending.pop(0)
+                inflight.append(remote_fn.remote(src, self._ops))
+            head = inflight.pop(0)
+            ray_tpu.wait([head], num_returns=1)
+            yield ("ref", head)
+
+    def _iter_blocks(self) -> Iterator[Block]:
+        import ray_tpu
+        from ..core import runtime as _rt
+
+        if self._materialized is not None:
+            yield from self._materialized
+            return
+        if not _rt.is_initialized():
+            # No runtime: execute inline (local convenience).
+            for src in self._sources:
+                yield _apply_ops(src(), self._ops)
+            return
+        for kind, item in self._execute_refs():
+            yield item if kind == "value" else ray_tpu.get(item)
+
+    def materialize(self) -> "Dataset":
+        out = Dataset([], [], self._window)
+        out._materialized = list(self._iter_blocks())
+        out._sources = [(lambda b=b: b) for b in out._materialized]
+        return out
+
+    # -------------------------------------------------------- consumption
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self._iter_blocks():
+            yield from BlockAccessor.for_block(block).iter_rows()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        import numpy as np
+
+        buf: List[Any] = []
+        for block in self._iter_blocks():
+            buf.extend(BlockAccessor.for_block(block).iter_rows())
+            while len(buf) >= batch_size:
+                chunk, buf = buf[:batch_size], buf[batch_size:]
+                yield self._format_batch(chunk, batch_format)
+        if buf and not drop_last:
+            yield self._format_batch(buf, batch_format)
+
+    @staticmethod
+    def _format_batch(rows: List[Any], batch_format: str):
+        block = build_block(rows)
+        acc = BlockAccessor.for_block(block)
+        if batch_format == "numpy":
+            return acc.to_numpy_batch()
+        if batch_format == "pandas":
+            return acc.to_pandas()
+        if batch_format == "arrow":
+            return acc.to_arrow()
+        return rows
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        total = 0
+        for block in self._iter_blocks():
+            total += BlockAccessor.for_block(block).num_rows()
+        return total
+
+    def schema(self):
+        for block in self._iter_blocks():
+            return BlockAccessor.for_block(block).schema()
+        return None
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    # ----------------------------------------------------------- barriers
+    def split(self, n: int, *, equal: bool = True) -> List["Dataset"]:
+        """Split into n datasets (for per-worker shards).  Splits at block
+        granularity when possible, else row granularity."""
+        blocks = list(self._iter_blocks())
+        if len(blocks) >= n and len(blocks) % n == 0:
+            per = len(blocks) // n
+            groups = [blocks[i * per:(i + 1) * per] for i in range(n)]
+        else:
+            rows = []
+            for b in blocks:
+                rows.extend(BlockAccessor.for_block(b).iter_rows())
+            if equal:
+                cut = len(rows) // n
+                groups = [[build_block(rows[i * cut:(i + 1) * cut])]
+                          for i in range(n)]
+            else:
+                import numpy as np
+
+                idx = np.array_split(np.arange(len(rows)), n)
+                groups = [[build_block([rows[i] for i in part])]
+                          for part in idx]
+        out = []
+        for g in groups:
+            d = Dataset([], [], self._window)
+            d._materialized = g
+            d._sources = [(lambda b=b: b) for b in g]
+            out.append(d)
+        return out
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        import random
+
+        rows = self.take_all()
+        rng = random.Random(seed)
+        rng.shuffle(rows)
+        n_blocks = max(len(self._sources), 1)
+        per = max(len(rows) // n_blocks, 1)
+        blocks = [build_block(rows[i:i + per])
+                  for i in range(0, len(rows), per)]
+        d = Dataset([], [], self._window)
+        d._materialized = blocks
+        d._sources = [(lambda b=b: b) for b in blocks]
+        return d
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        rows = self.take_all()
+        import numpy as np
+
+        parts = np.array_split(np.arange(len(rows)), num_blocks)
+        blocks = [build_block([rows[i] for i in part]) for part in parts]
+        d = Dataset([], [], self._window)
+        d._materialized = blocks
+        d._sources = [(lambda b=b: b) for b in blocks]
+        return d
+
+    def sum(self, key: Optional[str] = None):
+        total = 0
+        for row in self.iter_rows():
+            total += row[key] if key else row
+        return total
+
+    # ------------------------------------------------------------- output
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self._iter_blocks()):
+            table = BlockAccessor.for_block(block).to_arrow()
+            pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def __repr__(self):
+        return (f"Dataset(blocks={len(self._sources)}, "
+                f"ops={[o.kind for o in self._ops]})")

@@ -1,0 +1,12 @@
+"""ray_tpu.rl — the RL stack (new-API shape: EnvRunner/Learner/RLModule).
+
+Role-equivalent to the reference's RLlib new API stack (ref: SURVEY.md
+§2.4 — Algorithm over EnvRunnerGroup + LearnerGroup of JAX learners; the
+legacy policy/evaluation stack is intentionally not replicated, per
+SURVEY.md §7 hard-parts note).
+"""
+
+from .algorithm import PPO, AlgorithmConfig  # noqa: F401
+from .env_runner import EnvRunnerGroup, SingleAgentEnvRunner  # noqa
+from .learner import LearnerGroup, PPOConfig, PPOJaxLearner  # noqa
+from .rl_module import JaxRLModule, RLModuleSpec  # noqa: F401

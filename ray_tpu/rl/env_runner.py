@@ -1,0 +1,130 @@
+"""EnvRunner — rollout collection actors.
+
+Role-equivalent to the reference's SingleAgentEnvRunner (ref:
+rllib/env/single_agent_env_runner.py:139 sample(): gym vector envs +
+RLModule inference) and EnvRunnerGroup (rllib/env/env_runner_group.py:71).
+Runners hold the env + a copy of the module params; ``sample`` steps the
+vector env with jitted exploration forwards and returns flat numpy
+batches ready for the learner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+class SingleAgentEnvRunner:
+    """Plain class; wrapped as an actor by EnvRunnerGroup."""
+
+    def __init__(self, env_fn: Callable, module_spec, num_envs: int = 1,
+                 seed: int = 0):
+        import gymnasium as gym
+
+        from .rl_module import JaxRLModule
+
+        self.envs = gym.vector.SyncVectorEnv(
+            [lambda i=i: env_fn() for i in range(num_envs)])
+        self.num_envs = num_envs
+        self.module = JaxRLModule(module_spec)
+        self.params = None
+        self._seed = seed
+        self._rng_key = None
+        self._obs, _ = self.envs.reset(seed=seed)
+        self._episode_returns = np.zeros(num_envs)
+        self._completed_returns: List[float] = []
+        self._fwd = None
+
+    def set_weights(self, params) -> bool:
+        import jax
+
+        self.params = jax.device_put(params)
+        if self._fwd is None:
+            self._fwd = jax.jit(self.module.forward_exploration)
+            self._rng_key = jax.random.PRNGKey(self._seed)
+        return True
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect num_steps vector steps; returns [T*N, ...] batches
+        with bootstrap values for GAE."""
+        import jax
+
+        assert self.params is not None, "set_weights first"
+        obs_b, act_b, rew_b, done_b, logp_b, val_b = [], [], [], [], [], []
+        for _ in range(num_steps):
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            action, logp, value = self._fwd(self.params, self._obs, sub)
+            action = np.asarray(action)
+            next_obs, reward, term, trunc, _ = self.envs.step(action)
+            done = np.logical_or(term, trunc)
+            obs_b.append(self._obs)
+            act_b.append(action)
+            rew_b.append(reward)
+            done_b.append(done)
+            logp_b.append(np.asarray(logp))
+            val_b.append(np.asarray(value))
+            self._episode_returns += reward
+            for i, d in enumerate(done):
+                if d:
+                    self._completed_returns.append(
+                        float(self._episode_returns[i]))
+                    self._episode_returns[i] = 0.0
+            self._obs = next_obs
+        _, _, last_value = self._fwd(
+            self.params, self._obs,
+            jax.random.PRNGKey(0))
+        return {
+            "obs": np.stack(obs_b),          # [T, N, obs_dim]
+            "actions": np.stack(act_b),      # [T, N]
+            "rewards": np.stack(rew_b).astype(np.float32),
+            "dones": np.stack(done_b).astype(np.float32),
+            "logp": np.stack(logp_b).astype(np.float32),
+            "values": np.stack(val_b).astype(np.float32),
+            "last_values": np.asarray(last_value, np.float32),  # [N]
+        }
+
+    def episode_stats(self, window: int = 100) -> Dict[str, float]:
+        recent = self._completed_returns[-window:]
+        return {
+            "episodes_total": len(self._completed_returns),
+            "episode_return_mean": float(np.mean(recent)) if recent
+            else 0.0,
+        }
+
+
+class EnvRunnerGroup:
+    """N runner actors with weight broadcast + parallel sampling (ref:
+    env_runner_group.py foreach_env_runner)."""
+
+    def __init__(self, env_fn: Callable, module_spec,
+                 num_runners: int = 1, num_envs_per_runner: int = 1):
+        from ..core import serialization
+
+        serialization.ensure_code_portable(env_fn)
+        actor_cls = ray_tpu.remote(SingleAgentEnvRunner)
+        self.runners = [
+            actor_cls.remote(env_fn, module_spec, num_envs_per_runner,
+                             seed=1000 + 17 * i)
+            for i in range(num_runners)
+        ]
+
+    def set_weights(self, params) -> None:
+        ray_tpu.get([r.set_weights.remote(params) for r in self.runners])
+
+    def sample(self, num_steps_per_runner: int) -> List[Dict]:
+        return ray_tpu.get([r.sample.remote(num_steps_per_runner)
+                            for r in self.runners])
+
+    def stats(self) -> List[Dict]:
+        return ray_tpu.get([r.episode_stats.remote()
+                            for r in self.runners])
+
+    def shutdown(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,236 @@
+"""Learner — gradient updates on rollout batches, JAX-native.
+
+Role-equivalent to the reference's Learner/LearnerGroup (ref:
+rllib/core/learner/learner.py:109 with update_from_batch:967; torch DDP
+wrapping at torch_learner.py:500).  The JAX shape: the entire PPO update
+(GAE targets precomputed, minibatch epochs via lax control flow) is one
+jitted function; multi-learner data parallelism averages gradients
+through the host collective group instead of DDP.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+
+
+@dataclass
+class PPOConfig:
+    lr: float = 3e-4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    num_epochs: int = 4
+    minibatch_size: int = 128
+    grad_clip: float = 0.5
+
+
+def compute_gae(batch: Dict[str, np.ndarray], gamma: float,
+                lam: float) -> Tuple[np.ndarray, np.ndarray]:
+    """GAE advantages + value targets from a [T, N] rollout."""
+    rewards, dones = batch["rewards"], batch["dones"]
+    values, last_values = batch["values"], batch["last_values"]
+    t_len, n = rewards.shape
+    adv = np.zeros((t_len, n), np.float32)
+    last_gae = np.zeros(n, np.float32)
+    next_value = last_values
+    for t in range(t_len - 1, -1, -1):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    targets = adv + values
+    return adv, targets
+
+
+class PPOJaxLearner:
+    """Owns params + optimizer; update() runs the jitted PPO step."""
+
+    def __init__(self, module_spec, config: Optional[PPOConfig] = None,
+                 seed: int = 0):
+        import jax
+        import optax
+
+        from .rl_module import JaxRLModule
+
+        self.cfg = config or PPOConfig()
+        self.module = JaxRLModule(module_spec)
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(self.cfg.grad_clip),
+            optax.adam(self.cfg.lr))
+        self.opt_state = self.optimizer.init(self.params)
+        self._update_fn = None
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> bool:
+        import jax
+
+        self.params = jax.device_put(params)
+        self.opt_state = self.optimizer.init(self.params)
+        return True
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.cfg
+        module = self.module
+
+        def loss_fn(params, mb):
+            logits, values = module.forward_train(params, mb["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, mb["actions"][:, None], axis=-1)[:, 0]
+            ratio = jnp.exp(logp - mb["logp_old"])
+            adv = mb["adv"]
+            surrogate = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - cfg.clip_eps, 1 + cfg.clip_eps) * adv)
+            pi_loss = -jnp.mean(surrogate)
+            vf_loss = jnp.mean((values - mb["targets"]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jax.nn.softmax(logits) * logp_all, axis=-1))
+            total = pi_loss + cfg.vf_coeff * vf_loss \
+                - cfg.entropy_coeff * entropy
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update(params, opt_state, batch, rng):
+            n = batch["obs"].shape[0]
+            mb_size = min(cfg.minibatch_size, n)
+            n_mb = max(n // mb_size, 1)
+
+            def epoch(carry, rng_e):
+                params, opt_state = carry
+                perm = jax.random.permutation(rng_e, n)
+
+                def mb_step(carry, idx):
+                    params, opt_state = carry
+                    take = jax.lax.dynamic_slice_in_dim(
+                        perm, idx * mb_size, mb_size)
+                    mb = {k: batch[k][take] for k in
+                          ("obs", "actions", "logp_old", "adv",
+                           "targets")}
+                    (loss, aux), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(params, mb)
+                    updates, opt_state = self.optimizer.update(
+                        grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+                    return (params, opt_state), {**aux, "loss": loss}
+
+                (params, opt_state), metrics = jax.lax.scan(
+                    mb_step, (params, opt_state), jnp.arange(n_mb))
+                return (params, opt_state), metrics
+
+            rngs = jax.random.split(rng, cfg.num_epochs)
+            (params, opt_state), metrics = jax.lax.scan(
+                epoch, (params, opt_state), rngs)
+            mean_metrics = {k: jnp.mean(v) for k, v in metrics.items()}
+            return params, opt_state, mean_metrics
+
+        return jax.jit(update)
+
+    def update_from_batch(self, rollout: Dict[str, np.ndarray]
+                          ) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        adv, targets = compute_gae(rollout, cfg.gamma, cfg.gae_lambda)
+        adv_flat = adv.reshape(-1)
+        adv_flat = (adv_flat - adv_flat.mean()) / (adv_flat.std() + 1e-8)
+        batch = {
+            "obs": rollout["obs"].reshape(
+                -1, rollout["obs"].shape[-1]).astype(np.float32),
+            "actions": rollout["actions"].reshape(-1).astype(np.int32),
+            "logp_old": rollout["logp"].reshape(-1),
+            "adv": adv_flat.astype(np.float32),
+            "targets": targets.reshape(-1).astype(np.float32),
+        }
+        if self._update_fn is None:
+            self._update_fn = self._build_update()
+        self._step_rng = getattr(self, "_step_rng",
+                                 jax.random.PRNGKey(123))
+        self._step_rng, sub = jax.random.split(self._step_rng)
+        self.params, self.opt_state, metrics = self._update_fn(
+            self.params, self.opt_state,
+            {k: jnp.asarray(v) for k, v in batch.items()}, sub)
+        return {k: float(v) for k, v in jax.device_get(metrics).items()}
+
+
+class LearnerGroup:
+    """1..N learner actors; batches shard across learners and updated
+    params average (data-parallel update, the reference's multi-learner
+    DDP shape at learner_group.py:80)."""
+
+    def __init__(self, module_spec, config: Optional[PPOConfig] = None,
+                 num_learners: int = 0):
+        self.local: Optional[PPOJaxLearner] = None
+        self.actors: List[Any] = []
+        if num_learners <= 0:
+            self.local = PPOJaxLearner(module_spec, config)
+        else:
+            cls = ray_tpu.remote(PPOJaxLearner)
+            self.actors = [cls.remote(module_spec, config, seed=0)
+                           for _ in range(num_learners)]
+
+    def get_weights(self):
+        if self.local is not None:
+            return self.local.get_weights()
+        return ray_tpu.get(self.actors[0].get_weights.remote())
+
+    def update(self, rollouts: List[Dict]) -> Dict[str, float]:
+        import jax
+        import numpy as np
+
+        if self.local is not None:
+            merged = _merge_rollouts(rollouts)
+            return self.local.update_from_batch(merged)
+        # Shard rollouts across learners; average refreshed params.
+        shards = np.array_split(np.arange(len(rollouts)),
+                                len(self.actors))
+        refs = []
+        for actor, idx in zip(self.actors, shards):
+            sub = [rollouts[i] for i in idx] or rollouts[:1]
+            refs.append(actor.update_from_batch.remote(
+                _merge_rollouts(sub)))
+        metrics = ray_tpu.get(refs)
+        weights = ray_tpu.get([a.get_weights.remote()
+                               for a in self.actors])
+        mean_w = jax.tree_util.tree_map(
+            lambda *xs: np.mean(np.stack(xs), axis=0), *weights)
+        ray_tpu.get([a.set_weights.remote(mean_w) for a in self.actors])
+        out: Dict[str, float] = {}
+        for k in metrics[0]:
+            out[k] = float(np.mean([m[k] for m in metrics]))
+        return out
+
+    def shutdown(self) -> None:
+        for a in self.actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+def _merge_rollouts(rollouts: List[Dict]) -> Dict[str, np.ndarray]:
+    if len(rollouts) == 1:
+        return rollouts[0]
+    out = {}
+    for k in rollouts[0]:
+        axis = 0 if k == "last_values" else 1  # concat over env axis
+        out[k] = np.concatenate([r[k] for r in rollouts], axis=axis)
+    return out

@@ -1,0 +1,67 @@
+"""RLModule — the neural policy abstraction, JAX-native.
+
+Role-equivalent to the reference's RLModule (ref:
+rllib/core/rl_module/rl_module.py with torch/tf2 impls; here the impl is
+flax).  A module owns pure functions over a params pytree:
+forward_exploration (sampling actions), forward_inference (greedy), and
+forward_train (logits+values for the learner) — all jittable, so the
+learner update compiles into one XLA program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RLModuleSpec:
+    observation_dim: int
+    action_dim: int                 # discrete action count
+    hidden: Tuple[int, ...] = (64, 64)
+    dtype: Any = jnp.float32
+
+
+class _PolicyValueNet(nn.Module):
+    spec: RLModuleSpec
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs.astype(self.spec.dtype)
+        for i, h in enumerate(self.spec.hidden):
+            x = nn.tanh(nn.Dense(h, name=f"fc_{i}")(x))
+        logits = nn.Dense(self.spec.action_dim, name="pi")(x)
+        value = nn.Dense(1, name="vf")(x)[..., 0]
+        return logits, value
+
+
+class JaxRLModule:
+    """Discrete-action policy+value MLP (ref: the default PPO torch
+    module rllib/algorithms/ppo/torch/ppo_torch_rl_module.py)."""
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+        self.net = _PolicyValueNet(spec)
+
+    def init(self, rng) -> Any:
+        obs = jnp.zeros((1, self.spec.observation_dim))
+        return self.net.init(rng, obs)
+
+    def forward_train(self, params, obs):
+        return self.net.apply(params, obs)
+
+    def forward_exploration(self, params, obs, rng):
+        logits, value = self.net.apply(params, obs)
+        action = jax.random.categorical(rng, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), action]
+        return action, logp, value
+
+    def forward_inference(self, params, obs):
+        logits, _ = self.net.apply(params, obs)
+        return jnp.argmax(logits, axis=-1)

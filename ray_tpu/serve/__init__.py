@@ -1,0 +1,116 @@
+"""ray_tpu.serve — model serving over the cluster runtime.
+
+Role-equivalent to the reference's Ray Serve (ref: SURVEY.md §2.4 —
+serve.run -> controller -> replicas, HTTP proxy, pow-2 routing,
+DeploymentHandle composition).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from .controller import (CONTROLLER_NAME, DeploymentHandle,  # noqa
+                         ServeController)
+from .deployment import (Application, AutoscalingConfig,  # noqa
+                         Deployment, deployment)
+
+_http_proxy = None
+
+
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        cls = ray_tpu.remote(ServeController)
+        # Control-plane actors are IO-bound: 0 CPUs, like the reference's
+        # serve controller/proxy actors.
+        return cls.options(name=CONTROLLER_NAME, max_concurrency=16,
+                           num_cpus=0, get_if_exists=True,
+                           lifetime="detached").remote()
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/",
+        http: bool = False) -> DeploymentHandle:
+    """Deploy an application graph; returns the ingress handle (ref:
+    serve/api.py:496 serve.run)."""
+    from ..core import serialization
+
+    ctl = _get_or_create_controller()
+
+    def deploy_app(node: Application, is_root: bool) -> DeploymentHandle:
+        # Depth-first: children deploy first; their handles replace the
+        # Application objects in parent init args (model composition).
+        args = tuple(
+            deploy_app(a, False) if isinstance(a, Application) else a
+            for a in node.init_args)
+        kwargs = {
+            k: deploy_app(v, False) if isinstance(v, Application) else v
+            for k, v in node.init_kwargs.items()}
+        d = node.deployment
+        serialization.ensure_code_portable(d.func_or_class)
+        import cloudpickle
+
+        payload = cloudpickle.dumps(d.func_or_class)
+        prefix = d.route_prefix
+        if is_root and prefix is None:
+            prefix = route_prefix
+        ray_tpu.get(ctl.deploy.remote(
+            d.name, payload, args, kwargs, d.num_replicas,
+            d.is_function, prefix, d.ray_actor_options))
+        return DeploymentHandle(d.name)
+
+    handle = deploy_app(app, True)
+    if http:
+        start_http_proxy()
+    return handle
+
+
+def start_http_proxy(port: int = 0) -> int:
+    """Start (or reuse) the HTTP ingress; returns the bound port."""
+    global _http_proxy
+    from .proxy import HTTPProxy
+
+    if _http_proxy is None:
+        cls = ray_tpu.remote(HTTPProxy)
+        _http_proxy = cls.options(max_concurrency=32, num_cpus=0,
+                                  name="rt_serve_proxy",
+                                  get_if_exists=True).remote(port)
+    return ray_tpu.get(_http_proxy.port.remote())
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def status() -> Dict[str, Any]:
+    ctl = _get_or_create_controller()
+    return ray_tpu.get(ctl.list_deployments.remote())
+
+
+def scale(deployment_name: str, num_replicas: int) -> int:
+    ctl = _get_or_create_controller()
+    return ray_tpu.get(ctl.scale.remote(deployment_name, num_replicas))
+
+
+def delete(deployment_name: str) -> None:
+    ctl = _get_or_create_controller()
+    ray_tpu.get(ctl.delete.remote(deployment_name))
+
+
+def shutdown() -> None:
+    global _http_proxy
+    try:
+        ctl = ray_tpu.get_actor(CONTROLLER_NAME)
+        for name in list(ray_tpu.get(ctl.list_deployments.remote())):
+            ray_tpu.get(ctl.delete.remote(name))
+        ray_tpu.kill(ctl)
+    except ValueError:
+        pass
+    if _http_proxy is not None:
+        try:
+            ray_tpu.kill(_http_proxy)
+        except Exception:
+            pass
+        _http_proxy = None

@@ -1,0 +1,215 @@
+"""Serve controller + replicas + handles + router.
+
+Role-equivalent to the reference's ServeController/DeploymentState/
+Router (ref: serve/_private/controller.py, deployment_state.py:1248
+replica management, router.py:321 + pow_2_scheduler.py:52).  The
+controller is a named actor reconciling replica actors per deployment;
+DeploymentHandle routes calls with power-of-two-choices on ongoing
+request counts; replica death is detected on call failure and repaired
+by the reconciler.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from .deployment import Application, Deployment
+
+CONTROLLER_NAME = "rt_serve_controller"
+
+
+class _Replica:
+    """Hosts one replica of a deployment (class instance or function)."""
+
+    def __init__(self, cls_payload: bytes, init_args: tuple,
+                 init_kwargs: dict, is_function: bool):
+        import cloudpickle
+
+        target = cloudpickle.loads(cls_payload)
+        self._is_function = is_function
+        self._ongoing = 0
+        if is_function:
+            self._fn = target
+            self._instance = None
+        else:
+            self._instance = target(*init_args, **init_kwargs)
+            self._fn = None
+
+    def handle_request(self, args: tuple, kwargs: dict):
+        import asyncio
+        import inspect
+
+        self._ongoing += 1
+        try:
+            target = self._fn if self._is_function else self._instance
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.get_event_loop().run_until_complete(
+                    result) if not asyncio.get_event_loop().is_running() \
+                    else asyncio.run_coroutine_threadsafe(
+                        result, asyncio.get_event_loop()).result()
+            return result
+        finally:
+            self._ongoing -= 1
+
+    def call_method(self, method: str, args: tuple, kwargs: dict):
+        self._ongoing += 1
+        try:
+            return getattr(self._instance, method)(*args, **kwargs)
+        finally:
+            self._ongoing -= 1
+
+    def ongoing(self) -> int:
+        return self._ongoing
+
+    def health(self) -> bool:
+        return True
+
+
+class ServeController:
+    """Named actor: deployment table + replica reconciliation."""
+
+    def __init__(self):
+        self.deployments: Dict[str, Dict[str, Any]] = {}
+
+    def deploy(self, name: str, cls_payload: bytes, init_args: tuple,
+               init_kwargs: dict, num_replicas: int, is_function: bool,
+               route_prefix: Optional[str],
+               actor_options: Dict[str, Any]) -> bool:
+        entry = self.deployments.get(name)
+        if entry is None:
+            entry = self.deployments[name] = {
+                "replicas": [], "route_prefix": route_prefix,
+                "target": num_replicas, "payload": cls_payload,
+                "init": (init_args, init_kwargs),
+                "is_function": is_function,
+                "actor_options": actor_options}
+        else:
+            entry.update(payload=cls_payload,
+                         init=(init_args, init_kwargs),
+                         target=num_replicas, route_prefix=route_prefix,
+                         is_function=is_function,
+                         actor_options=actor_options)
+            # Redeploy: drop old replicas, fresh code/config.
+            for r in entry["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+            entry["replicas"] = []
+        self.reconcile(name)
+        return True
+
+    def reconcile(self, name: str) -> int:
+        entry = self.deployments[name]
+        replica_cls = ray_tpu.remote(_Replica).options(
+            max_concurrency=32, **entry.get("actor_options", {}))
+        while len(entry["replicas"]) < entry["target"]:
+            args, kwargs = entry["init"]
+            entry["replicas"].append(replica_cls.remote(
+                entry["payload"], args, kwargs, entry["is_function"]))
+        while len(entry["replicas"]) > entry["target"]:
+            victim = entry["replicas"].pop()
+            try:
+                ray_tpu.kill(victim)
+            except Exception:
+                pass
+        return len(entry["replicas"])
+
+    def scale(self, name: str, num_replicas: int) -> int:
+        self.deployments[name]["target"] = num_replicas
+        return self.reconcile(name)
+
+    def replace_dead_replica(self, name: str, index: int) -> bool:
+        entry = self.deployments.get(name)
+        if entry is None or index >= len(entry["replicas"]):
+            return False
+        args, kwargs = entry["init"]
+        replica_cls = ray_tpu.remote(_Replica).options(
+            max_concurrency=32, **entry.get("actor_options", {}))
+        entry["replicas"][index] = replica_cls.remote(
+            entry["payload"], args, kwargs, entry["is_function"])
+        return True
+
+    def get_replicas(self, name: str) -> List[Any]:
+        entry = self.deployments.get(name)
+        return entry["replicas"] if entry else []
+
+    def routes(self) -> Dict[str, str]:
+        return {e["route_prefix"]: name
+                for name, e in self.deployments.items()
+                if e["route_prefix"]}
+
+    def list_deployments(self) -> Dict[str, Dict[str, Any]]:
+        return {name: {"target": e["target"],
+                       "replicas": len(e["replicas"]),
+                       "route_prefix": e["route_prefix"]}
+                for name, e in self.deployments.items()}
+
+    def delete(self, name: str) -> bool:
+        entry = self.deployments.pop(name, None)
+        if entry:
+            for r in entry["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        return entry is not None
+
+
+class DeploymentHandle:
+    """Client-side router with power-of-two-choices (ref:
+    pow_2_scheduler.py:52)."""
+
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+        self._replicas: List[Any] = []
+        self._refresh_time = 0.0
+
+    def _controller(self):
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.time()
+        if force or not self._replicas or now - self._refresh_time > 5.0:
+            self._replicas = ray_tpu.get(
+                self._controller().get_replicas.remote(
+                    self.deployment_name))
+            self._refresh_time = now
+        if not self._replicas:
+            raise RuntimeError(
+                f"deployment {self.deployment_name!r} has no replicas")
+
+    def _pick(self):
+        self._refresh()
+        if len(self._replicas) == 1:
+            return self._replicas[0]
+        a, b = random.sample(self._replicas, 2)
+        try:
+            qa, qb = ray_tpu.get([a.ongoing.remote(), b.ongoing.remote()],
+                                 timeout=2.0)
+        except Exception:
+            self._refresh(force=True)
+            return random.choice(self._replicas)
+        return a if qa <= qb else b
+
+    def remote(self, *args, **kwargs):
+        replica = self._pick()
+        return replica.handle_request.remote(args, kwargs)
+
+    def method(self, method_name: str):
+        handle = self
+
+        class _M:
+            def remote(self, *args, **kwargs):
+                replica = handle._pick()
+                return replica.call_method.remote(method_name, args,
+                                                  kwargs)
+
+        return _M()
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name,))

@@ -1,0 +1,100 @@
+"""HTTP ingress proxy — aiohttp server actor routing to deployments.
+
+Role-equivalent to the reference's HTTPProxy (ref:
+serve/_private/proxy.py:763 — uvicorn ASGI per node; here aiohttp in a
+dedicated actor).  Routes are pulled from the controller and refreshed
+periodically (the reference pushes them via long-poll; same effect).
+JSON in / JSON out: request body parses to the handler's argument;
+responses serialize back.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Optional
+
+
+class HTTPProxy:
+    """Actor: runs an aiohttp server thread, proxies to handles."""
+
+    def __init__(self, port: int = 0):
+        import asyncio
+
+        from aiohttp import web
+
+        self._routes: Dict[str, Any] = {}
+        self._port = port
+        self._actual_port = None
+        self._ready = threading.Event()
+
+        async def handler(request: "web.Request") -> "web.Response":
+            import ray_tpu
+            from .controller import DeploymentHandle
+
+            path = "/" + request.match_info.get("tail", "")
+            target = None
+            target_prefix = ""
+            for prefix, name in self._route_table().items():
+                if path == prefix or path.startswith(
+                        prefix.rstrip("/") + "/"):
+                    if len(prefix) > len(target_prefix):
+                        target, target_prefix = name, prefix
+            if target is None:
+                return web.json_response(
+                    {"error": f"no route for {path}"}, status=404)
+            if request.can_read_body:
+                try:
+                    payload = await request.json()
+                except Exception:
+                    payload = (await request.read()).decode()
+            else:
+                payload = dict(request.query) or None
+            handle = self._routes.get(target)
+            if handle is None:
+                handle = self._routes[target] = DeploymentHandle(target)
+            loop = asyncio.get_event_loop()
+            ref = await loop.run_in_executor(
+                None, lambda: handle.remote(payload))
+            result = await loop.run_in_executor(
+                None, lambda: ray_tpu.get(ref, timeout=60))
+            if isinstance(result, (dict, list, str, int, float, bool,
+                                   type(None))):
+                return web.json_response({"result": result})
+            return web.json_response({"result": repr(result)})
+
+        def run_server():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handler)
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, "127.0.0.1", self._port)
+            loop.run_until_complete(site.start())
+            self._actual_port = site._server.sockets[0].getsockname()[1]
+            self._ready.set()
+            loop.run_forever()
+
+        self._thread = threading.Thread(target=run_server, daemon=True)
+        self._thread.start()
+        self._ready.wait(30)
+
+    def _route_table(self) -> Dict[str, str]:
+        import time
+
+        import ray_tpu
+
+        now = time.time()
+        cached = getattr(self, "_route_cache", None)
+        if cached is None or now - cached[1] > 2.0:
+            from .controller import CONTROLLER_NAME
+
+            ctl = ray_tpu.get_actor(CONTROLLER_NAME)
+            table = ray_tpu.get(ctl.routes.remote())
+            self._route_cache = (table, now)
+        return self._route_cache[0]
+
+    def port(self) -> int:
+        self._ready.wait(30)
+        return self._actual_port

@@ -1,0 +1,9 @@
+"""ray_tpu.tune — hyperparameter search over the cluster runtime.
+
+Role-equivalent to the reference's Ray Tune (ref: SURVEY.md §2.4).
+"""
+
+from .schedulers import ASHAScheduler, FIFOScheduler  # noqa: F401
+from .search import (choice, grid_search, loguniform, randint,  # noqa
+                     sample_from, uniform)
+from .tuner import (ResultGrid, TuneConfig, Tuner, report)  # noqa: F401

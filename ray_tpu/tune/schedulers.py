@@ -1,0 +1,68 @@
+"""Trial schedulers: FIFO and ASHA (asynchronous successive halving).
+
+Role-equivalent to the reference's tune.schedulers (ref:
+python/ray/tune/schedulers/async_hyperband.py ASHAScheduler).  The
+controller calls ``on_result`` for every report; the scheduler answers
+CONTINUE or STOP.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"          # culled by the scheduler (under-performing)
+COMPLETE = "COMPLETE"  # budget (max_t) reached — a normal finish
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    """Asynchronous successive halving on ``metric`` at rungs
+    grace_period * reduction_factor^k."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 3,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.eta = reduction_factor
+        self.time_attr = time_attr
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        # rung milestone -> list of recorded metric values
+        self.recorded: Dict[int, List[float]] = defaultdict(list)
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return COMPLETE  # budget exhausted — not a cull
+        for rung in reversed(self.rungs):
+            if t == rung:
+                peers = self.recorded[rung]
+                peers.append(float(value))
+                if len(peers) < self.eta:
+                    return CONTINUE  # not enough peers; be optimistic
+                ranked = sorted(peers)
+                if self.mode == "max":
+                    ranked = ranked[::-1]
+                cutoff_idx = max(len(ranked) // self.eta - 1, 0)
+                cutoff = ranked[cutoff_idx]
+                good = (value <= cutoff if self.mode == "min"
+                        else value >= cutoff)
+                return CONTINUE if good else STOP
+        return CONTINUE

@@ -1,0 +1,123 @@
+"""Search spaces and suggestion generation.
+
+Role-equivalent to the reference's tune.search (ref:
+python/ray/tune/search/ — BasicVariantGenerator, sample.py domains).
+Domains: uniform/loguniform/randint/choice/grid_search; the basic
+generator crosses grid axes and samples the rest per trial.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low),
+                                    math.log(self.high)))
+
+
+@dataclass
+class RandInt(Domain):
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class Choice(Domain):
+    options: List[Any]
+
+    def sample(self, rng):
+        return rng.choice(self.options)
+
+
+@dataclass
+class GridSearch:
+    values: List[Any]
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(options: List[Any]) -> Choice:
+    return Choice(list(options))
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(list(values))
+
+
+def sample_from(fn: Callable[[Dict], Any]):
+    return _SampleFrom(fn)
+
+
+@dataclass
+class _SampleFrom:
+    fn: Callable
+
+
+class BasicVariantGenerator:
+    """Cross product of grid axes x num_samples random draws of the rest
+    (ref: tune/search/basic_variant.py)."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+
+    def variants(self) -> List[Dict[str, Any]]:
+        grid_keys = [k for k, v in self.param_space.items()
+                     if isinstance(v, GridSearch)]
+        grid_values = [self.param_space[k].values for k in grid_keys]
+        combos = list(itertools.product(*grid_values)) if grid_keys \
+            else [()]
+        out: List[Dict[str, Any]] = []
+        for _ in range(self.num_samples):
+            for combo in combos:
+                cfg: Dict[str, Any] = {}
+                for k, v in self.param_space.items():
+                    if isinstance(v, GridSearch):
+                        cfg[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self.rng)
+                    elif isinstance(v, _SampleFrom):
+                        cfg[k] = v.fn(cfg)
+                    else:
+                        cfg[k] = v
+                out.append(cfg)
+        return out

@@ -1,0 +1,167 @@
+"""Data library + Tune on the cluster runtime."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rtd
+from ray_tpu import tune as rtt
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _rt():
+    rt = ray_tpu.init(mode="cluster", num_cpus=8)
+    yield rt
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------------- data
+def test_range_count_take():
+    ds = rtd.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert ds.take(3) == [{"id": 0}, {"id": 1}, {"id": 2}]
+    assert ds.num_blocks() == 4
+
+
+def test_map_filter_pipeline():
+    ds = (rtd.range(50, parallelism=4)
+          .map(lambda r: {"x": r["id"] * 2})
+          .filter(lambda r: r["x"] % 4 == 0))
+    vals = [r["x"] for r in ds.take_all()]
+    assert vals == [i * 2 for i in range(50) if (i * 2) % 4 == 0]
+
+
+def test_map_batches_numpy():
+    ds = rtd.range(40, parallelism=2).map_batches(
+        lambda b: {"y": b["id"].astype(np.float64) + 0.5},
+        batch_format="numpy")
+    total = sum(r["y"] for r in ds.take_all())
+    assert total == sum(i + 0.5 for i in range(40))
+
+
+def test_iter_batches_shapes():
+    ds = rtd.range(100, parallelism=4)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32)]
+    assert sum(sizes) == 100
+    assert sizes[:3] == [32, 32, 32]
+
+
+def test_split_for_workers():
+    shards = rtd.range(80, parallelism=4).split(4)
+    assert len(shards) == 4
+    counts = [s.count() for s in shards]
+    assert sum(counts) == 80
+    assert all(c == 20 for c in counts)
+    all_ids = sorted(r["id"] for s in shards for r in s.take_all())
+    assert all_ids == list(range(80))
+
+
+def test_from_items_and_shuffle():
+    ds = rtd.from_items([{"v": i} for i in range(20)])
+    sh = ds.random_shuffle(seed=42)
+    vals = [r["v"] for r in sh.take_all()]
+    assert sorted(vals) == list(range(20))
+    assert vals != list(range(20))
+
+
+def test_parquet_roundtrip(tmp_path):
+    ds = rtd.range(30, parallelism=2).map(
+        lambda r: {"id": r["id"], "sq": r["id"] ** 2})
+    ds.write_parquet(str(tmp_path / "out"))
+    back = rtd.read_parquet(str(tmp_path / "out"))
+    assert back.count() == 30
+    assert sum(r["sq"] for r in back.take_all()) == sum(
+        i ** 2 for i in range(30))
+
+
+def test_dataset_trainer_integration(tmp_path):
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config):
+        from ray_tpu import train
+
+        shard = train.get_dataset_shard("train")
+        seen = sum(len(b["id"]) for b in shard.iter_batches(batch_size=8))
+        train.report({"rows_seen": seen})
+        return seen
+
+    ds = rtd.range(40, parallelism=4)
+    res = JaxTrainer(loop, train_loop_config={},
+                     scaling_config=ScalingConfig(num_workers=2),
+                     run_config=RunConfig(name="d1",
+                                          storage_path=str(tmp_path)),
+                     datasets={"train": ds}).fit()
+    assert res.error is None
+    assert res.metrics["rows_seen"] == 20  # 40 rows over 2 workers
+
+
+# ------------------------------------------------------------------- tune
+def test_tuner_grid_and_best():
+    def objective(config):
+        score = (config["x"] - 3) ** 2 + config["y"]
+        rtt.report({"score": score})
+
+    tuner = rtt.Tuner(
+        objective,
+        param_space={"x": rtt.grid_search([1, 2, 3, 4]), "y": 0.5},
+        tune_config=rtt.TuneConfig(metric="score", mode="min",
+                                   max_concurrent_trials=3))
+    grid = tuner.fit()
+    assert len(grid) == 4
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 0.5
+    assert grid.best_config["x"] == 3
+
+
+def test_tuner_random_sampling():
+    def objective(config):
+        rtt.report({"val": config["lr"]})
+
+    grid = rtt.Tuner(
+        objective,
+        param_space={"lr": rtt.loguniform(1e-4, 1e-1)},
+        tune_config=rtt.TuneConfig(num_samples=4, metric="val",
+                                   mode="min", seed=7)).fit()
+    vals = [r.metrics["val"] for r in grid]
+    assert len(vals) == 4
+    assert all(1e-4 <= v <= 1e-1 for v in vals)
+    assert len(set(vals)) == 4
+
+
+def test_asha_stops_bad_trials():
+    def objective(config):
+        import time
+
+        for i in range(8):
+            rtt.report({"loss": config["base"] + i * 0.001})
+            time.sleep(0.05)
+
+    sched = rtt.ASHAScheduler(metric="loss", mode="min", max_t=8,
+                              grace_period=2, reduction_factor=2)
+    grid = rtt.Tuner(
+        objective,
+        param_space={"base": rtt.grid_search([0.1, 0.2, 5.0, 9.0])},
+        tune_config=rtt.TuneConfig(metric="loss", mode="min",
+                                   scheduler=sched,
+                                   max_concurrent_trials=4)).fit()
+    statuses = {t.config["base"]: t.status for t in grid.trials}
+    # The clearly-bad configs should have been stopped early.
+    assert statuses[0.1] == "TERMINATED"
+    stopped = [b for b, s in statuses.items() if s == "STOPPED"]
+    assert 9.0 in stopped or 5.0 in stopped
+    best = grid.get_best_result()
+    assert best.metrics["loss"] < 1.0
+
+
+def test_tuner_trial_error_captured():
+    def objective(config):
+        if config["x"] == 2:
+            raise RuntimeError("bad trial")
+        rtt.report({"ok": 1})
+
+    grid = rtt.Tuner(
+        objective, param_space={"x": rtt.grid_search([1, 2])},
+        tune_config=rtt.TuneConfig(metric="ok", mode="max")).fit()
+    errs = [t for t in grid.trials if t.status == "ERROR"]
+    assert len(errs) == 1
+    assert "bad trial" in str(errs[0].error)

@@ -1,0 +1,122 @@
+"""Serve: deployments, handles, pow-2 routing, composition, scaling,
+HTTP ingress."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _rt():
+    rt = ray_tpu.init(mode="cluster", num_cpus=8)
+    yield rt
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _clean_deployments():
+    yield
+    # Replicas hold CPUs; drop them so later tests can schedule.
+    for name in list(serve.status()):
+        serve.delete(name)
+
+
+def test_function_deployment_roundtrip():
+    @serve.deployment
+    def square(x):
+        return {"sq": x["v"] ** 2}
+
+    handle = serve.run(square.bind(), route_prefix="/square")
+    out = ray_tpu.get(handle.remote({"v": 7}))
+    assert out == {"sq": 49}
+
+
+def test_class_deployment_with_state():
+    @serve.deployment(num_replicas=1)
+    class Greeter:
+        def __init__(self, greeting):
+            self.greeting = greeting
+            self.count = 0
+
+        def __call__(self, payload):
+            self.count += 1
+            return f"{self.greeting}, {payload['name']}! (#{self.count})"
+
+    handle = serve.run(Greeter.bind("Hello"), route_prefix="/greet")
+    r1 = ray_tpu.get(handle.remote({"name": "A"}))
+    r2 = ray_tpu.get(handle.remote({"name": "B"}))
+    assert r1 == "Hello, A! (#1)"
+    assert r2 == "Hello, B! (#2)"
+
+
+def test_model_composition():
+    @serve.deployment(name="featurizer")
+    class Featurizer:
+        def __call__(self, payload):
+            return {"feat": payload["x"] * 10}
+
+    @serve.deployment(name="head_model")
+    class Head:
+        def __init__(self, featurizer):
+            self.featurizer = featurizer
+
+        def __call__(self, payload):
+            feat = ray_tpu.get(self.featurizer.remote(payload))
+            return {"pred": feat["feat"] + 1}
+
+    handle = serve.run(Head.bind(Featurizer.bind()),
+                       route_prefix="/compose")
+    assert ray_tpu.get(handle.remote({"x": 4})) == {"pred": 41}
+
+
+def test_multiple_replicas_share_load():
+    import os
+
+    @serve.deployment(num_replicas=2, name="pids")
+    def which(_payload):
+        return os.getpid()
+
+    handle = serve.run(which.bind(), route_prefix="/pids")
+    pids = {ray_tpu.get(handle.remote({})) for _ in range(12)}
+    assert len(pids) == 2
+
+
+def test_scaling():
+    @serve.deployment(name="scaled", num_replicas=1)
+    def noop(_p):
+        return 1
+
+    serve.run(noop.bind(), route_prefix="/scaled")
+    assert serve.status()["scaled"]["replicas"] == 1
+    assert serve.scale("scaled", 3) == 3
+    assert serve.status()["scaled"]["replicas"] == 3
+    assert serve.scale("scaled", 1) == 1
+
+
+def test_http_ingress():
+    @serve.deployment(name="adder")
+    def add(payload):
+        return {"sum": payload["a"] + payload["b"]}
+
+    serve.run(add.bind(), route_prefix="/add")
+    port = serve.start_http_proxy()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/add",
+        data=json.dumps({"a": 2, "b": 40}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        body = json.load(resp)
+    assert body["result"]["sum"] == 42
+    # Unknown route -> 404.
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/nope", timeout=10)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
