@@ -1,0 +1,33 @@
+"""Env recipe for a virtual n-device CPU platform (hermetic mesh tests).
+
+This image's sitecustomize registers the 'axon' single-chip TPU backend
+and pins jax_platforms=axon whenever PALLAS_AXON_POOL_IPS is truthy, so
+forcing a CPU mesh needs three coordinated env edits BEFORE jax is
+imported.  Kept in one place (used by tests/conftest.py and
+__graft_entry__.dryrun_multichip) so the disarm recipe can't drift.
+
+This module must stay importable without jax.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import MutableMapping
+
+_FLAG = "--xla_force_host_platform_device_count"
+
+
+def apply_cpu_mesh_env(env: MutableMapping[str, str],
+                       n_devices: int) -> MutableMapping[str, str]:
+    """Mutate ``env`` so a fresh interpreter sees an n-device CPU platform.
+
+    Overwrites any stale device-count flag (a leftover =4 from a prior
+    recipe must not survive a request for 8 devices).
+    """
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # sitecustomize checks truthiness
+    flags = env.get("XLA_FLAGS", "")
+    flags = re.sub(rf"{_FLAG}=\S+", "", flags)
+    env["XLA_FLAGS"] = f"{flags} {_FLAG}={n_devices}".strip()
+    env.setdefault("JAX_ENABLE_X64", "0")
+    return env

@@ -8,19 +8,17 @@ python/ray/cluster_utils.py:135).
 """
 
 import os
+import sys
 
-# Force the CPU platform with 8 virtual devices.  This image's
-# sitecustomize registers the 'axon' TPU backend when
-# PALLAS_AXON_POOL_IPS is set and pins jax_platforms=axon — clear it so
-# the env reaches child worker processes too (sitecustomize checks its
-# truthiness at interpreter start).
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["PALLAS_AXON_POOL_IPS"] = ""
-prev = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in prev:
-    os.environ["XLA_FLAGS"] = (
-        prev + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Force the CPU platform with 8 virtual devices (shared recipe — also
+# used by __graft_entry__.dryrun_multichip's re-exec).  ray_tpu import is
+# jax-free, so this runs before jax initializes and reaches child worker
+# processes too.
+from ray_tpu._virtual_mesh import apply_cpu_mesh_env  # noqa: E402
+
+apply_cpu_mesh_env(os.environ, 8)
 
 def _force_cpu_jax():
     # The current process may already have axon registered (sitecustomize
