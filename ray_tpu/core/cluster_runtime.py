@@ -26,7 +26,8 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .config import RuntimeConfig
 from .errors import (ActorDiedError, ActorError, GetTimeoutError,
-                     ObjectLostError, TaskError, WorkerCrashedError)
+                     ObjectLostError, TaskCancelledError, TaskError,
+                     WorkerCrashedError)
 from .ids import ActorID, JobID, NodeID, ObjectID
 from .object_store import MemoryStore, SharedObjectStore
 from .object_ref import ObjectRef
@@ -45,6 +46,37 @@ class _StoreRef:
     def __init__(self, size: int, node_hint: str = ""):
         self.size = size
         self.node_hint = node_hint
+
+
+class _Submission:
+    """Owner-side in-flight record for one normal task, for cancel().
+
+    Tracks where the lease request currently waits (agent_addr +
+    request_id while queued) and where the task runs once pushed
+    (worker_addr/worker_id), so cancellation can be routed (ref:
+    core_worker.cc CancelTask / node_manager CancelWorkerLease).
+    """
+
+    __slots__ = ("spec", "request_id", "cancelled", "force", "agent_addr",
+                 "worker_addr", "worker_id", "pushed", "done",
+                 "cancel_event")
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.request_id = uuid.uuid4().hex
+        self.cancelled = False
+        self.force = False
+        self.agent_addr: Optional[str] = None
+        self.worker_addr: Optional[str] = None
+        self.worker_id = None
+        self.pushed = False
+        self.done = False
+        # Interrupts dep-resolution waits; set on the io loop by cancel().
+        self.cancel_event = asyncio.Event()
+
+
+class _CancelledInFlight(Exception):
+    """Internal: submission observed its cancel flag mid-flight."""
 
 
 class ClusterRuntime(BaseRuntime):
@@ -83,6 +115,7 @@ class ClusterRuntime(BaseRuntime):
         self._worker_clients: Dict[str, RpcClient] = {}
         self._actor_cache: Dict[ActorID, Dict] = {}
         self._pending_returns: Set[ObjectID] = set()
+        self._submissions: Dict[ObjectID, _Submission] = {}
         self._completion_events: Dict[ObjectID, asyncio.Event] = {}
         self._pending_lock = threading.Lock()
         self._actor_submit_locks: Dict[ActorID, asyncio.Lock] = {}
@@ -195,6 +228,13 @@ class ClusterRuntime(BaseRuntime):
                 await asyncio.sleep(0.5)
                 continue
             self._event_cursor = r.get("cursor", self._event_cursor)
+            if r.get("cursor_expired"):
+                # Events were trimmed past our cursor: cached actor states
+                # may silently be stale (a missed DEAD would route calls to
+                # a gone address forever).  Full resync: drop the cache so
+                # the next _actor_info falls through to the controller.
+                self._actor_cache.clear()
+                continue
             for _seq, ch, data in r.get("events", []):
                 if ch == "actor":
                     aid = data["actor_id"]
@@ -204,10 +244,12 @@ class ClusterRuntime(BaseRuntime):
                         cached["worker_addr"] = data.get("worker_addr", "")
 
     # ------------------------------------------------- dependency resolution
-    async def _resolve_deps(self, spec: TaskSpec) -> None:
+    async def _resolve_deps(self, spec: TaskSpec,
+                            sub: Optional[_Submission] = None) -> None:
         """Owner-side resolution (ref: dependency_resolver.h): wait for
         owned pending refs; inline small owned values; leave plane refs for
-        the executor to pull."""
+        the executor to pull.  A cancelled submission interrupts the wait
+        — otherwise cancel() on a dep-blocked task would hang forever."""
         for arg in spec.args:
             if arg.kind != ArgKind.OBJECT_REF:
                 continue
@@ -215,7 +257,19 @@ class ClusterRuntime(BaseRuntime):
             with self._pending_lock:
                 pending = oid in self._pending_returns
             if pending:
-                await self._completion_event(oid).wait()
+                waiters = [asyncio.ensure_future(
+                    self._completion_event(oid).wait())]
+                if sub is not None:
+                    waiters.append(asyncio.ensure_future(
+                        sub.cancel_event.wait()))
+                try:
+                    await asyncio.wait(
+                        waiters, return_when=asyncio.FIRST_COMPLETED)
+                finally:
+                    for w in waiters:
+                        w.cancel()
+                if sub is not None and sub.cancelled:
+                    raise _CancelledInFlight()
             ok, val = self.memory.get_nowait(oid)
             if ok and not isinstance(val, _StoreRef):
                 if isinstance(val, TaskError):
@@ -228,13 +282,23 @@ class ClusterRuntime(BaseRuntime):
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
         oids = spec.return_object_ids()
         self._mark_pending(oids)
+        sub = _Submission(spec)
+        for oid in oids:
+            self._submissions[oid] = sub
         self.io.call_soon(lambda: self.io.loop.create_task(
-            self._submit_normal(spec)))
+            self._submit_normal(spec, sub)))
         return [ObjectRef(o) for o in oids]
 
-    async def _submit_normal(self, spec: TaskSpec) -> None:
+    async def _submit_normal(self, spec: TaskSpec,
+                             sub: Optional[_Submission] = None) -> None:
+        sub = sub or _Submission(spec)
         try:
-            await self._resolve_deps(spec)
+            await self._resolve_deps(spec, sub)
+        except _CancelledInFlight:
+            self._fail_returns(spec, TaskError.from_exception(
+                TaskCancelledError(
+                    f"task {spec.display_name()} was cancelled")))
+            return
         except TaskError as e:
             self._fail_returns(spec, e)
             return
@@ -242,8 +306,22 @@ class ClusterRuntime(BaseRuntime):
         delay = self.config.task_retry_delay_ms / 1000.0
         while True:
             try:
-                result = await self._lease_and_push(spec)
+                if sub.cancelled:
+                    raise _CancelledInFlight()
+                result = await self._lease_and_push(spec, sub)
+            except _CancelledInFlight:
+                self._fail_returns(spec, TaskError.from_exception(
+                    TaskCancelledError(
+                        f"task {spec.display_name()} was cancelled")))
+                return
             except (RpcError, WorkerCrashedError) as e:
+                if sub.cancelled:
+                    # force-cancel killed the worker mid-push; report
+                    # cancellation, not a crash, and never retry.
+                    self._fail_returns(spec, TaskError.from_exception(
+                        TaskCancelledError(
+                            f"task {spec.display_name()} was cancelled")))
+                    return
                 if attempts_left != 0:
                     if attempts_left > 0:
                         attempts_left -= 1
@@ -258,7 +336,8 @@ class ClusterRuntime(BaseRuntime):
                 return
             if not result.ok:
                 err = result.error
-                if spec.retry_exceptions and attempts_left != 0:
+                if spec.retry_exceptions and attempts_left != 0 \
+                        and not sub.cancelled:
                     if attempts_left > 0:
                         attempts_left -= 1
                     await asyncio.sleep(delay)
@@ -269,10 +348,12 @@ class ClusterRuntime(BaseRuntime):
             self._accept_returns(spec, result)
             return
 
-    async def _lease_and_push(self, spec: TaskSpec) -> TaskResult:
+    async def _lease_and_push(self, spec: TaskSpec,
+                              sub: _Submission) -> TaskResult:
         payload = {
             "resources": dict(spec.resources.amounts),
             "strategy": spec.scheduling.kind,
+            "request_id": sub.request_id,
         }
         if spec.scheduling.kind == "PLACEMENT_GROUP":
             payload["pg_id"] = spec.scheduling.placement_group_id
@@ -293,8 +374,14 @@ class ClusterRuntime(BaseRuntime):
         # normal_task_submitter.h:182 RequestNewWorkerIfNeeded).
         hops = 0
         while True:
+            sub.agent_addr = agent_addr
             agent = await self._agent_for(agent_addr)
             grant = await agent.call("request_lease", payload)
+            if grant.get("cancelled") or sub.cancelled:
+                if grant.get("ok"):
+                    await agent.call("return_lease",
+                                     {"lease_id": grant["lease_id"]})
+                raise _CancelledInFlight()
             if grant.get("ok"):
                 break
             if grant.get("retry_at") and hops < 8:
@@ -305,6 +392,9 @@ class ClusterRuntime(BaseRuntime):
             raise RemoteCallError(ValueError(
                 grant.get("error", "lease request failed")))
         lease_id = grant["lease_id"]
+        sub.worker_addr = grant["worker_addr"]
+        sub.worker_id = grant.get("worker_id")
+        sub.pushed = True
         try:
             worker = await self._worker_client(grant["worker_addr"])
             reply = await worker.call("push_task", {
@@ -361,6 +451,9 @@ class ClusterRuntime(BaseRuntime):
 
     def _fail_returns(self, spec: TaskSpec, err: TaskError) -> None:
         for oid in spec.return_object_ids():
+            sub = self._submissions.pop(oid, None)
+            if sub is not None:
+                sub.done = True
             self._store_result_value(oid, err)
 
     def _accept_returns(self, spec: TaskSpec, result: TaskResult) -> None:
@@ -368,6 +461,9 @@ class ClusterRuntime(BaseRuntime):
 
         oids = spec.return_object_ids()
         for oid, (kind, data) in zip(oids, result.returns):
+            sub = self._submissions.pop(oid, None)
+            if sub is not None:
+                sub.done = True
             if kind == "inline":
                 self._store_result_value(oid, serialization.unpack(data))
             else:  # ("store", (size, node_hint))
@@ -670,9 +766,45 @@ class ClusterRuntime(BaseRuntime):
             return False
 
     def cancel(self, ref: ObjectRef, force: bool) -> None:
-        # Best-effort: queued-but-unleased tasks cannot be recalled yet.
-        # (Ref parity gap tracked for a later round: core_worker CancelTask.)
-        pass
+        """Cancel the task producing ``ref`` (ref: core_worker.cc
+        CancelTask).  Queued lease requests are yanked from the agent;
+        running tasks get TaskCancelledError raised in their executing
+        thread (force=True kills the worker process instead).  Actor
+        tasks are not cancellable (they would break call ordering) —
+        a warning is emitted, matching the surfaced-gap contract."""
+        sub = self._submissions.get(ref.id)
+        if sub is None or sub.done:
+            import logging
+
+            logging.getLogger("ray_tpu").warning(
+                "cancel(%s): no in-flight submission (already finished, "
+                "unknown, or an actor task — not cancellable)", ref)
+            return
+        sub.cancelled = True
+        sub.force = force
+        self.io.call_soon(sub.cancel_event.set)
+        try:
+            self.io.run(self._cancel_inflight(sub), timeout=10.0)
+        except Exception:
+            pass  # flag checks in the submit loop still stop the task
+
+    async def _cancel_inflight(self, sub: _Submission) -> None:
+        if not sub.pushed:
+            if sub.agent_addr is not None:
+                agent = await self._agent_for(sub.agent_addr)
+                await agent.call("cancel_lease_request",
+                                 {"request_id": sub.request_id})
+            return
+        if sub.force:
+            # Kill the worker process; the push RPC fails and the submit
+            # loop reports TaskCancelledError (cancel flag suppresses
+            # retries).
+            agent = await self._agent_for(sub.agent_addr)
+            await agent.call("kill_worker", {"worker_id": sub.worker_id})
+        elif sub.worker_addr is not None:
+            worker = await self._worker_client(sub.worker_addr)
+            await worker.call("cancel_task",
+                              {"task_id": sub.spec.task_id})
 
     # -------------------------------------------------------- introspection
     def cluster_resources(self) -> Dict[str, float]:

@@ -77,8 +77,10 @@ class Controller:
         self.actors: Dict[ActorID, ActorEntry] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}
         self.kv: Dict[str, bytes] = {}
+        self.kv_list_counts: Dict[str, int] = {}  # kv_append item counts
         self.object_dir: Dict[ObjectID, Dict] = {}  # oid -> {nodes:set,size}
         self.events: Dict[str, List[Tuple[int, Any]]] = {}
+        self.events_trimmed_to: Dict[str, int] = {}  # ch -> last trimmed seq
         self.event_seq = 0
         self.event_waiters: List[asyncio.Event] = []
         self.jobs: Dict[int, Dict] = {}
@@ -90,7 +92,7 @@ class Controller:
             "register_node", "heartbeat", "list_nodes", "resource_view",
             "register_actor", "actor_started", "actor_died", "get_actor",
             "lookup_named_actor", "kill_actor", "worker_exited",
-            "kv_put", "kv_get", "kv_del", "kv_keys", "kv_append",
+            "kv_put", "kv_get", "kv_del", "kv_keys", "kv_append", "kv_list",
             "publish_locations", "remove_locations", "locate_object",
             "free_object", "poll_events", "register_job", "finish_job",
             "create_placement_group", "remove_placement_group",
@@ -105,7 +107,12 @@ class Controller:
         self.events.setdefault(channel, []).append((self.event_seq, data))
         log = self.events[channel]
         if len(log) > self.config.task_event_buffer_size:
-            del log[: len(log) // 2]
+            n = len(log) // 2
+            # Remember the highest trimmed seq so slow subscribers whose
+            # cursor predates it get an explicit cursor_expired signal
+            # (they must resync) instead of silently skipping events.
+            self.events_trimmed_to[channel] = log[n - 1][0]
+            del log[:n]
         for ev in self.event_waiters:
             ev.set()
 
@@ -356,6 +363,7 @@ class Controller:
         if not overwrite and p["key"] in self.kv:
             return {"ok": False, "exists": True}
         self.kv[p["key"]] = p["value"]
+        self.kv_list_counts.pop(p["key"], None)  # no longer a list value
         self._publish("kv", {"key": p["key"]})
         return {"ok": True}
 
@@ -364,6 +372,7 @@ class Controller:
 
     async def kv_del(self, p):
         self.kv.pop(p["key"], None)
+        self.kv_list_counts.pop(p["key"], None)
         return {"ok": True}
 
     async def kv_keys(self, p):
@@ -371,13 +380,32 @@ class Controller:
         return [k for k in self.kv if k.startswith(prefix)]
 
     async def kv_append(self, p):
-        """Atomic append to a list value — rendezvous building block."""
-        cur = self.kv.get(p["key"], b"")
-        items = cur.split(b"\x00") if cur else []
-        items.append(p["value"])
-        self.kv[p["key"]] = b"\x00".join(items)
-        self._publish("kv", {"key": p["key"]})
-        return {"count": len(items)}
+        """Atomic append to a list value — rendezvous building block.
+        Items are stored length-prefixed so binary values (including NUL
+        bytes) round-trip intact; read back with kv_list."""
+        key = p["key"]
+        cur = self.kv.get(key, b"")
+        item = p["value"]
+        self.kv[key] = cur + len(item).to_bytes(4, "little") + item
+        if key not in self.kv_list_counts:  # key may predate via kv_put
+            self.kv_list_counts[key] = len(self._kv_items(key)) - 1
+        self.kv_list_counts[key] += 1
+        self._publish("kv", {"key": key})
+        return {"count": self.kv_list_counts[key]}
+
+    def _kv_items(self, key: str) -> List[bytes]:
+        blob = self.kv.get(key, b"")
+        items, pos = [], 0
+        while pos + 4 <= len(blob):
+            n = int.from_bytes(blob[pos:pos + 4], "little")
+            pos += 4
+            items.append(blob[pos:pos + n])
+            pos += n
+        return items
+
+    async def kv_list(self, p):
+        """Decode a kv_append-built list value into its items."""
+        return self._kv_items(p["key"])
 
     # -------------------------------------------------------- object plane
     async def publish_locations(self, p):
@@ -427,20 +455,29 @@ class Controller:
 
     # ---------------------------------------------------------------- pubsub
     async def poll_events(self, p):
-        """Cursor-based long-poll (ref: src/ray/pubsub long-poll design)."""
+        """Cursor-based long-poll (ref: src/ray/pubsub long-poll design).
+        If the cursor predates trimmed history on any requested channel,
+        the reply carries cursor_expired=True: events were lost and the
+        subscriber must do a full resync (list_actors/list_nodes)."""
         cursor = p.get("cursor", 0)
         channels = p.get("channels", ["actor", "node"])
         timeout = p.get("timeout", 30.0)
         deadline = asyncio.get_event_loop().time() + timeout
         while True:
+            # Recomputed each pass: a trim can happen while we long-poll.
+            expired = any(cursor < self.events_trimmed_to.get(ch, 0)
+                          for ch in channels)
             out = []
             for ch in channels:
                 for seq, data in self.events.get(ch, []):
                     if seq > cursor:
                         out.append((seq, ch, data))
-            if out:
+            if out or expired:
                 out.sort()
-                return {"events": out, "cursor": out[-1][0]}
+                new_cursor = out[-1][0] if out else \
+                    max(cursor, self.event_seq)
+                return {"events": out, "cursor": new_cursor,
+                        "cursor_expired": expired}
             remaining = deadline - asyncio.get_event_loop().time()
             if remaining <= 0:
                 return {"events": [], "cursor": cursor}

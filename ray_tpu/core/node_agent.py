@@ -118,6 +118,7 @@ class NodeAgent:
         self._spawned_procs: List[subprocess.Popen] = []
         for name in [
             "request_lease", "return_lease", "lease_status",
+            "cancel_lease_request",
             "register_worker", "worker_heartbeat",
             "task_blocked", "task_unblocked",
             "register_object", "pull_object", "fetch_raw", "delete_object",
@@ -298,6 +299,14 @@ class NodeAgent:
             if req.future.done():
                 continue
             granted = await self._try_grant(req.payload)
+            if req.future.done():
+                # Cancelled while we were granting (cancel_lease_request
+                # resolved the future mid-await): give the lease back.
+                if granted is not None:
+                    lease = self.leases.get(granted["lease_id"])
+                    if lease is not None:
+                        self._release_lease(lease)
+                continue
             if granted is None:
                 still.append(req)
             else:
@@ -477,6 +486,19 @@ class NodeAgent:
         for k, cap in self.total.amounts.items():
             if self.available.amounts.get(k, 0.0) > cap:
                 self.available.amounts[k] = cap
+
+    async def cancel_lease_request(self, p):
+        """Yank a queued-but-ungranted lease request (task cancellation;
+        ref: node_manager CancelWorkerLease)."""
+        rid = p.get("request_id")
+        for req in list(self.pending):
+            if req.payload.get("request_id") == rid \
+                    and not req.future.done():
+                req.future.set_result(
+                    {"ok": False, "cancelled": True})
+                self.pending.remove(req)
+                return {"ok": True, "cancelled": True}
+        return {"ok": True, "cancelled": False}
 
     async def return_lease(self, p):
         lease = self.leases.get(p["lease_id"])

@@ -33,7 +33,7 @@ from . import runtime as runtime_mod
 from . import serialization
 from .cluster_runtime import ClusterRuntime
 from .config import RuntimeConfig
-from .errors import ActorError, TaskError
+from .errors import ActorError, TaskCancelledError, TaskError
 from .ids import ActorID, JobID, WorkerID
 from .rpc import RpcClient, RpcServer
 from .task import ArgKind, TaskResult, TaskSpec
@@ -60,8 +60,16 @@ class Worker:
         self.actor_executor: Optional[ThreadPoolExecutor] = None
         self.actor_lock = threading.Lock()
         self._exit_event = asyncio.Event()
+        # Cancellation state: ids cancelled before execution started
+        # (bounded FIFO — a cancel that never matches a push must not
+        # accumulate forever), and the (task_id, thread ident) currently
+        # running in _task_executor.
+        from collections import OrderedDict
+
+        self._cancelled_task_ids: "OrderedDict[Any, None]" = OrderedDict()
+        self._current_sync_task: Optional[Tuple[Any, int]] = None
         for name in ["push_task", "create_actor", "push_actor_task",
-                     "ping", "exit"]:
+                     "cancel_task", "ping", "exit"]:
             self.server.register(name, getattr(self, name))
 
     async def start(self) -> None:
@@ -160,6 +168,22 @@ class Worker:
             self.runtime.current_lease_id = lease_id
         prev_task = self.runtime._ctx.current_task_id
         self.runtime.set_current_task(spec.task_id)
+        if spec.task_id in self._cancelled_task_ids:
+            self._cancelled_task_ids.pop(spec.task_id, None)
+            self.runtime.set_current_task(prev_task)
+            self.runtime.current_lease_id = prev_lease
+            return TaskResult(
+                task_id=spec.task_id, ok=False,
+                error=TaskError.from_exception(TaskCancelledError(
+                    f"task {spec.display_name()} cancelled before start")))
+        # Revoke any async exception still pending on this pooled thread
+        # from a cancel that raced a previous task's completion — it must
+        # not fire inside an unrelated task.
+        import ctypes
+
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(threading.get_ident()), None)
+        self._current_sync_task = (spec.task_id, threading.get_ident())
         try:
             pos, kwargs = self._resolve_args(spec)
             result = fn(*pos, **kwargs)
@@ -169,6 +193,7 @@ class Worker:
             return TaskResult(task_id=spec.task_id, ok=False,
                               error=kind.from_exception(e))
         finally:
+            self._current_sync_task = None
             self.runtime.set_current_task(prev_task)
             self.runtime.current_lease_id = prev_lease
 
@@ -275,6 +300,31 @@ class Worker:
         except BaseException as e:  # noqa: BLE001
             return TaskResult(task_id=spec.task_id, ok=False,
                               error=ActorError.from_exception(e))
+
+    async def cancel_task(self, p):
+        """Best-effort in-band cancellation (ref: core_worker CancelTask →
+        KeyboardInterrupt in the executing thread).  A running task gets
+        TaskCancelledError raised asynchronously in its thread; a queued
+        task is marked so it errors out instead of starting."""
+        tid = p["task_id"]
+        cur = self._current_sync_task
+        if cur is not None and cur[0] == tid:
+            import ctypes
+
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(cur[1]),
+                ctypes.py_object(TaskCancelledError))
+            if self._current_sync_task != cur:
+                # The task finished before delivery; revoke so the
+                # pending exception can't fire in the next task (the
+                # next _execute_sync also clears at entry as a backstop).
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(cur[1]), None)
+            return {"ok": True, "interrupted": True}
+        self._cancelled_task_ids[tid] = None
+        while len(self._cancelled_task_ids) > 512:
+            self._cancelled_task_ids.popitem(last=False)
+        return {"ok": True, "interrupted": False}
 
     # --------------------------------------------------------------- admin
     async def ping(self, _p):

@@ -1,0 +1,115 @@
+"""Task cancellation semantics on the cluster backend.
+
+Mirrors the reference's cancellation contract (ref:
+python/ray/tests/test_cancel.py, core_worker.cc CancelTask): a queued task
+is recalled from the lease queue before it starts; a running task gets
+TaskCancelledError raised in its executing thread; force=True kills the
+worker process.  All three surface TaskCancelledError at the get() site.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.errors import TaskCancelledError
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _rt():
+    rt = ray_tpu.init(mode="cluster", num_cpus=2)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def _interruptible_spin(seconds):
+    # Python-bytecode loop (not one long sleep syscall) so the async
+    # exception raised by cancel_task lands promptly.
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        time.sleep(0.005)
+    return "finished"
+
+
+def test_cancel_queued_task():
+    @ray_tpu.remote(num_cpus=2)
+    def blocker():
+        return _interruptible_spin(20)
+
+    @ray_tpu.remote(num_cpus=1)
+    def victim():
+        return "ran"
+
+    b = blocker.remote()
+    time.sleep(0.5)  # let blocker occupy the node
+    v = victim.remote()
+    time.sleep(0.3)  # victim now queued behind blocker
+    ray_tpu.cancel(v)
+    t0 = time.time()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(v, timeout=10)
+    assert time.time() - t0 < 5, "cancelled task should fail fast"
+    ray_tpu.cancel(b, force=True)
+    with pytest.raises((TaskCancelledError, Exception)):
+        ray_tpu.get(b, timeout=15)
+
+
+def test_cancel_running_task_in_band():
+    @ray_tpu.remote
+    def slow():
+        return _interruptible_spin(30)
+
+    ref = slow.remote()
+    time.sleep(1.0)  # ensure it is running
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=15)
+
+
+def test_cancel_running_task_force_kills_worker():
+    @ray_tpu.remote
+    def sleeper():
+        time.sleep(60)  # force-kill works even inside a blocking syscall
+        return "finished"
+
+    ref = sleeper.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=15)
+
+
+def test_cancel_dep_blocked_task():
+    """cancel() must interrupt a task still waiting on an unresolved
+    dependency (it was never pushed anywhere)."""
+    @ray_tpu.remote(num_cpus=2)
+    def slow_dep():
+        return _interruptible_spin(20)
+
+    @ray_tpu.remote
+    def consumer(x):
+        return x
+
+    dep = slow_dep.remote()
+    time.sleep(0.3)
+    victim = consumer.remote(dep)
+    time.sleep(0.3)  # victim is blocked in dep resolution
+    ray_tpu.cancel(victim)
+    t0 = time.time()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(victim, timeout=10)
+    assert time.time() - t0 < 5
+    ray_tpu.cancel(dep, force=True)
+    with pytest.raises(Exception):
+        ray_tpu.get(dep, timeout=15)
+
+
+def test_cancel_finished_task_is_noop():
+    @ray_tpu.remote
+    def quick():
+        return 7
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref) == 7
+    ray_tpu.cancel(ref)  # warns, does not raise
+    assert ray_tpu.get(ref) == 7
