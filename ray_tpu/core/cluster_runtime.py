@@ -117,7 +117,25 @@ class ClusterRuntime(BaseRuntime):
         self._pending_returns: Set[ObjectID] = set()
         self._submissions: Dict[ObjectID, _Submission] = {}
         self._completion_events: Dict[ObjectID, asyncio.Event] = {}
-        self._pending_lock = threading.Lock()
+        # RLock: taken on the ObjectRef.__del__ path (remove_local_ref),
+        # which cyclic GC can fire on a thread already inside it.
+        self._pending_lock = threading.RLock()
+        # -- Distributed reference counting state (ref:
+        # reference_count.h:66, redesigned: each process reports only its
+        # 0<->1 holder transitions to the centralized controller
+        # directory).  RLock: remove_local_ref runs from ObjectRef.__del__,
+        # which GC may fire while this thread already holds the lock.
+        self._refs_lock = threading.RLock()
+        self._local_ref_counts: Dict[ObjectID, int] = {}
+        self._submitted_holds: Dict[ObjectID, int] = {}  # in-flight args
+        self._owned_ids: Set[ObjectID] = set()      # ids created here
+        self._owned_plane: Set[ObjectID] = set()    # owned + in the plane
+        self._borrows_registered: Set[ObjectID] = set()
+        self._free_on_complete: Set[ObjectID] = set()
+        # Lineage: creation specs of owned plane objects, replayed when
+        # every copy is lost (ref: object_recovery_manager.h:38).
+        self._lineage: Dict[ObjectID, TaskSpec] = {}
+        self._reconstructing: Dict[ObjectID, asyncio.Future] = {}
         self._actor_submit_locks: Dict[ActorID, asyncio.Lock] = {}
         self._shutdown_flag = False
         self._event_cursor = 0
@@ -197,6 +215,8 @@ class ClusterRuntime(BaseRuntime):
     def _mark_pending(self, oids: List[ObjectID]) -> None:
         with self._pending_lock:
             self._pending_returns.update(oids)
+        with self._refs_lock:
+            self._owned_ids.update(oids)
 
     def _store_result_value(self, oid: ObjectID, value: Any) -> None:
         self.memory.put(oid, value)
@@ -205,6 +225,112 @@ class ClusterRuntime(BaseRuntime):
         ev = self._completion_events.get(oid)
         if ev is not None:
             ev.set()
+        with self._refs_lock:
+            free_now = (oid in self._free_on_complete
+                        and self._local_ref_counts.get(oid, 0) == 0
+                        and self._submitted_holds.get(oid, 0) == 0)
+            self._free_on_complete.discard(oid)
+        if free_now:
+            self._release_object(oid)
+
+    # --------------------------------------------- reference counting hooks
+    def add_local_ref(self, object_id: ObjectID) -> None:
+        with self._refs_lock:
+            n = self._local_ref_counts.get(object_id, 0)
+            self._local_ref_counts[object_id] = n + 1
+            if n > 0 or object_id in self._owned_ids \
+                    or object_id in self._borrows_registered \
+                    or self._shutdown_flag:
+                return
+            # First local ref to a foreign object: we are a borrower —
+            # tell the directory so the owner's release can't free it
+            # from under us.
+            self._borrows_registered.add(object_id)
+        self._notify_async("add_borrower", {
+            "object_id": object_id, "holder": self._runtime_id})
+
+    def remove_local_ref(self, object_id: ObjectID) -> None:
+        if self._shutdown_flag:
+            return
+        with self._refs_lock:
+            n = self._local_ref_counts.get(object_id, 0) - 1
+            if n > 0:
+                self._local_ref_counts[object_id] = n
+                return
+            self._local_ref_counts.pop(object_id, None)
+            if n < 0:  # ref born under a previous runtime in this process
+                return
+            if self._submitted_holds.get(object_id, 0) > 0:
+                return  # release happens when the in-flight task finishes
+            with self._pending_lock:
+                if object_id in self._pending_returns:
+                    # Fire-and-forget: the producing task still runs; the
+                    # result is freed when it lands.
+                    self._free_on_complete.add(object_id)
+                    return
+        self._release_object(object_id)
+
+    def _add_submitted_holds(self, oids: List[ObjectID]) -> None:
+        """Pin args of an in-flight task (ref: reference_count.h
+        submitted_task_ref_count) — `f.remote(g.remote())` drops the inner
+        ref right after submission; the hold keeps the object alive until
+        the consuming task completes."""
+        with self._refs_lock:
+            for oid in oids:
+                self._submitted_holds[oid] = \
+                    self._submitted_holds.get(oid, 0) + 1
+
+    def _release_submitted_holds(self, oids: List[ObjectID]) -> None:
+        for oid in oids:
+            with self._refs_lock:
+                n = self._submitted_holds.get(oid, 0) - 1
+                if n > 0:
+                    self._submitted_holds[oid] = n
+                    continue
+                self._submitted_holds.pop(oid, None)
+                if self._local_ref_counts.get(oid, 0) > 0:
+                    continue
+                with self._pending_lock:
+                    if oid in self._pending_returns:
+                        self._free_on_complete.add(oid)
+                        continue
+            self._release_object(oid)
+
+    def _release_object(self, oid: ObjectID) -> None:
+        """All local holders are gone: drop the value and tell the
+        directory (owner release or borrow removal)."""
+        self.memory.delete(oid)
+        with self._refs_lock:
+            owned = oid in self._owned_ids
+            self._owned_ids.discard(oid)
+            plane = oid in self._owned_plane
+            self._owned_plane.discard(oid)
+            self._lineage.pop(oid, None)
+            borrowed = oid in self._borrows_registered
+            self._borrows_registered.discard(oid)
+        if owned and plane:
+            self._notify_async("owner_release", {"object_id": oid})
+        elif borrowed:
+            self._notify_async("remove_borrower", {
+                "object_id": oid, "holder": self._runtime_id})
+
+    def _notify_async(self, method: str, payload: Dict) -> None:
+        """Fire-and-forget controller notification from any thread
+        (including GC running __del__); must never block or raise."""
+        if self._shutdown_flag:
+            return
+        try:
+            self.io.call_soon(lambda: self.io.loop.create_task(
+                self._notify_ignore_errors(method, payload)))
+        except Exception:
+            pass
+
+    async def _notify_ignore_errors(self, method: str,
+                                    payload: Dict) -> None:
+        try:
+            await self._ctl.call(method, payload)
+        except (RpcError, RemoteCallError, asyncio.CancelledError):
+            pass
 
     async def _worker_client(self, addr: str) -> RpcClient:
         cli = self._worker_clients.get(addr)
@@ -282,16 +408,28 @@ class ClusterRuntime(BaseRuntime):
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
         oids = spec.return_object_ids()
         self._mark_pending(oids)
+        held = [a.object_id for a in spec.args
+                if a.kind == ArgKind.OBJECT_REF and a.object_id is not None]
+        self._add_submitted_holds(held)
         sub = _Submission(spec)
         for oid in oids:
             self._submissions[oid] = sub
         self.io.call_soon(lambda: self.io.loop.create_task(
-            self._submit_normal(spec, sub)))
+            self._submit_normal(spec, sub, held)))
         return [ObjectRef(o) for o in oids]
 
     async def _submit_normal(self, spec: TaskSpec,
-                             sub: Optional[_Submission] = None) -> None:
+                             sub: Optional[_Submission] = None,
+                             held: Optional[List[ObjectID]] = None) -> None:
         sub = sub or _Submission(spec)
+        try:
+            await self._submit_normal_inner(spec, sub)
+        finally:
+            if held:
+                self._release_submitted_holds(held)
+
+    async def _submit_normal_inner(self, spec: TaskSpec,
+                                   sub: _Submission) -> None:
         try:
             await self._resolve_deps(spec, sub)
         except _CancelledInFlight:
@@ -303,6 +441,7 @@ class ClusterRuntime(BaseRuntime):
             self._fail_returns(spec, e)
             return
         attempts_left = spec.max_retries
+        recoveries_left = 3  # bound on lost-arg reconstruct-and-retry
         delay = self.config.task_retry_delay_ms / 1000.0
         while True:
             try:
@@ -336,6 +475,16 @@ class ClusterRuntime(BaseRuntime):
                 return
             if not result.ok:
                 err = result.error
+                if isinstance(err, ObjectLostError) and not sub.cancelled \
+                        and recoveries_left > 0 \
+                        and await self._recover_lost_args(spec) \
+                        and (recoveries_left := recoveries_left - 1) >= 0:
+                    # An argument's copies were lost while the task was in
+                    # flight; the owner reconstructed them — retry without
+                    # consuming the user's retry budget (ref:
+                    # task_manager.cc resubmit on OBJECT_UNRECONSTRUCTABLE
+                    # is owner-driven, not a task failure).
+                    continue
                 if spec.retry_exceptions and attempts_left != 0 \
                         and not sub.cancelled:
                     if attempts_left > 0:
@@ -465,10 +614,27 @@ class ClusterRuntime(BaseRuntime):
             if sub is not None:
                 sub.done = True
             if kind == "inline":
+                # Unpacking materializes any embedded ObjectRefs, whose
+                # __init__ hooks register this process's borrows (queued
+                # on this same connection, so they reach the controller
+                # before the transit release below).
                 self._store_result_value(oid, serialization.unpack(data))
             else:  # ("store", (size, node_hint))
                 size, node_hint = data
+                with self._refs_lock:
+                    self._owned_plane.add(oid)
+                    if spec.kind == TaskKind.NORMAL:
+                        # Deterministic re-execution source for recovery;
+                        # actor results are not reconstructable (state).
+                        self._lineage[oid] = spec
                 self._store_result_value(oid, _StoreRef(size, node_hint))
+        # Ownership handoff complete: drop the worker's transit borrows on
+        # refs that travelled inside inline return values (the worker
+        # registered them before its own references died).
+        for emb in getattr(result, "transit_refs", None) or []:
+            self._notify_async("remove_borrower", {
+                "object_id": emb,
+                "holder": f"transit:{spec.task_id.hex()}"})
 
     # ------------------------------------------------------------- actors
     def create_actor(self, spec: TaskSpec) -> None:
@@ -479,10 +645,22 @@ class ClusterRuntime(BaseRuntime):
             "owner_addr": self._runtime_id}))
         if not r.get("ok"):
             raise ValueError(r.get("error", "actor registration failed"))
+        held = [a.object_id for a in spec.args
+                if a.kind == ArgKind.OBJECT_REF and a.object_id is not None]
+        self._add_submitted_holds(held)
         self.io.call_soon(lambda: self.io.loop.create_task(
-            self._create_actor_async(spec)))
+            self._create_actor_async(spec, held)))
 
-    async def _create_actor_async(self, spec: TaskSpec) -> None:
+    async def _create_actor_async(self, spec: TaskSpec,
+                                  held: Optional[List[ObjectID]]
+                                  = None) -> None:
+        try:
+            await self._create_actor_inner(spec)
+        finally:
+            if held:
+                self._release_submitted_holds(held)
+
+    async def _create_actor_inner(self, spec: TaskSpec) -> None:
         try:
             await self._resolve_deps(spec)
             payload = {
@@ -535,8 +713,11 @@ class ClusterRuntime(BaseRuntime):
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         oids = spec.return_object_ids()
         self._mark_pending(oids)
+        held = [a.object_id for a in spec.args
+                if a.kind == ArgKind.OBJECT_REF and a.object_id is not None]
+        self._add_submitted_holds(held)
         self.io.call_soon(lambda: self.io.loop.create_task(
-            self._submit_actor(spec)))
+            self._submit_actor(spec, held)))
         return [ObjectRef(o) for o in oids]
 
     async def _actor_info(self, actor_id: ActorID,
@@ -566,7 +747,8 @@ class ClusterRuntime(BaseRuntime):
             await asyncio.sleep(delay)
             delay = min(delay * 1.5, 0.5)
 
-    async def _submit_actor(self, spec: TaskSpec) -> None:
+    async def _submit_actor(self, spec: TaskSpec,
+                            held: Optional[List[ObjectID]] = None) -> None:
         """Actor calls execute in submission order for max_concurrency=1
         actors: the per-actor lock is taken in coroutine creation order
         (FIFO) and held across dep resolution + push, so the worker's
@@ -574,14 +756,18 @@ class ClusterRuntime(BaseRuntime):
         restarted actor needs no seq handshake (ref: the role of
         ActorSubmitQueue in transport/actor_task_submitter.h, redesigned
         around in-order connection delivery)."""
-        ordered = spec.max_concurrency <= 1
-        lock = self._actor_submit_locks.setdefault(
-            spec.actor_id, asyncio.Lock())
-        if ordered:
-            async with lock:
+        try:
+            ordered = spec.max_concurrency <= 1
+            lock = self._actor_submit_locks.setdefault(
+                spec.actor_id, asyncio.Lock())
+            if ordered:
+                async with lock:
+                    await self._submit_actor_inner(spec)
+            else:
                 await self._submit_actor_inner(spec)
-        else:
-            await self._submit_actor_inner(spec)
+        finally:
+            if held:
+                self._release_submitted_holds(held)
 
     async def _submit_actor_inner(self, spec: TaskSpec) -> None:
         try:
@@ -650,6 +836,9 @@ class ClusterRuntime(BaseRuntime):
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.current_task_id(), self.next_put_index())
         size = self.store.create_and_seal(oid, value)
+        with self._refs_lock:
+            self._owned_ids.add(oid)
+            self._owned_plane.add(oid)  # puts have no lineage (ref parity)
         self.io.run(self._agent.call("register_object",
                                      {"object_id": oid, "size": size}))
         self.memory.put(oid, _StoreRef(size))
@@ -670,13 +859,92 @@ class ClusterRuntime(BaseRuntime):
 
     def _fetch_store_value(self, oid: ObjectID,
                            timeout: Optional[float]) -> Any:
-        """Pull a plane object into the local node store and map it."""
-        r = self.io.run(self._agent.call("pull_object", {
-            "object_id": oid,
-            "timeout": timeout if timeout is not None else 3600.0}))
+        """Pull a plane object into the local node store and map it,
+        reconstructing from lineage if every copy was lost."""
+        r = self.io.run(self._pull_with_recovery(oid, timeout))
         if not r.get("ok"):
             raise ObjectLostError(oid.hex())
         return self.store.get(oid, r["size"])
+
+    async def _pull_with_recovery(self, oid: ObjectID,
+                                  timeout: Optional[float]) -> Dict:
+        t = timeout if timeout is not None else 3600.0
+        can_recover = oid in self._lineage
+        r = await self._agent.call("pull_object", {
+            "object_id": oid, "timeout": t, "fail_fast": can_recover})
+        if r.get("ok") or not can_recover:
+            return r
+        if not await self._reconstruct_object(oid):
+            return r
+        return await self._agent.call("pull_object",
+                                      {"object_id": oid, "timeout": t})
+
+    async def _recover_lost_args(self, spec: TaskSpec) -> bool:
+        """A pushed task failed with ObjectLostError: check its plane-ref
+        args against the directory and reconstruct the missing ones we
+        have lineage for.  Returns True if anything was recovered (the
+        caller retries the push)."""
+        recovered = False
+        for arg in spec.args:
+            if arg.kind != ArgKind.OBJECT_REF or arg.object_id is None:
+                continue
+            oid = arg.object_id
+            if oid not in self._lineage:
+                continue
+            try:
+                loc = await self._ctl.call("locate_object",
+                                           {"object_id": oid})
+            except RpcError:
+                loc = None
+            if not (loc and loc["nodes"]):
+                if not await self._reconstruct_object(oid):
+                    return False
+                recovered = True
+        return recovered
+
+    async def _reconstruct_object(self, oid: ObjectID,
+                                  depth: int = 0) -> bool:
+        """Re-execute the task that created ``oid`` (ref:
+        object_recovery_manager.h:38,90 — lineage reconstruction).  Upstream
+        plane dependencies that are themselves gone are reconstructed
+        first, depth-bounded.  Puts and actor-task results carry no
+        lineage and surface ObjectLostError instead."""
+        if depth > 8:
+            return False
+        spec = self._lineage.get(oid)
+        if spec is None:
+            return False
+        inflight = self._reconstructing.get(oid)
+        if inflight is not None:
+            return await asyncio.shield(inflight)
+        fut = asyncio.get_event_loop().create_future()
+        self._reconstructing[oid] = fut
+        ok = False
+        try:
+            for arg in spec.args:
+                if arg.kind != ArgKind.OBJECT_REF or arg.object_id is None:
+                    continue
+                try:
+                    loc = await self._ctl.call(
+                        "locate_object", {"object_id": arg.object_id})
+                except RpcError:
+                    loc = None
+                if not (loc and loc["nodes"]):
+                    if not await self._reconstruct_object(arg.object_id,
+                                                          depth + 1):
+                        return False
+            logger = __import__("logging").getLogger("ray_tpu")
+            logger.warning("reconstructing lost object %s by re-executing "
+                           "task %s", oid.hex()[:16], spec.display_name())
+            self._mark_pending(spec.return_object_ids())
+            await self._submit_normal(spec)
+            got, val = self.memory.get_nowait(oid)
+            ok = got and not isinstance(val, TaskError)
+            return ok
+        finally:
+            self._reconstructing.pop(oid, None)
+            if not fut.done():
+                fut.set_result(ok)
 
     def get(self, refs: List[ObjectRef],
             timeout: Optional[float]) -> List[Any]:

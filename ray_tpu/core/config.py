@@ -63,6 +63,10 @@ define_flag("object_store_memory_bytes", int, 2 * 1024**3,
 define_flag("object_inline_max_bytes", int, 100 * 1024,
             "Objects at or below this size are inlined in control messages "
             "instead of the shared-memory plane.")
+define_flag("arg_pull_timeout_s", float, 60.0,
+            "Executor-side bound on pulling one task argument; expiry "
+            "surfaces ObjectLostError so the owner can reconstruct from "
+            "lineage and retry instead of hanging.")
 define_flag("worker_pool_min_workers", int, 0,
             "Pre-started idle workers per node.")
 define_flag("worker_pool_max_workers", int, 0,

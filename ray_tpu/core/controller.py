@@ -94,7 +94,9 @@ class Controller:
             "lookup_named_actor", "kill_actor", "worker_exited",
             "kv_put", "kv_get", "kv_del", "kv_keys", "kv_append", "kv_list",
             "publish_locations", "remove_locations", "locate_object",
-            "free_object", "poll_events", "register_job", "finish_job",
+            "free_object", "owner_release", "add_borrower",
+            "remove_borrower", "link_induced_borrows",
+            "poll_events", "register_job", "finish_job",
             "create_placement_group", "remove_placement_group",
             "get_placement_group", "list_placement_groups",
             "list_actors", "cluster_shutdown", "ping", "drain_node",
@@ -206,16 +208,20 @@ class Controller:
                                                                  PENDING):
                 await self._handle_actor_failure(
                     actor, f"node {node.node_id.hex()[:8]} died")
-        # Drop object locations on that node; delete entries with no
-        # remaining copy (locate_object must return None for them).
+        # Drop object locations on that node.  Entries that lose their
+        # last copy are KEPT (with empty nodes) so borrower/owner state
+        # survives lineage reconstruction; locate_object reports them as
+        # location-less.  Fully-idle entries are dropped.
         gone = []
         for oid, info in self.object_dir.items():
             info["nodes"].discard(node.node_id)
             if not info["nodes"]:
                 gone.append(oid)
         for oid in gone:
-            del self.object_dir[oid]
             self._publish("object_lost", {"object_id": oid})
+            info = self.object_dir[oid]
+            if not info["borrowers"] and not info.get("induced"):
+                del self.object_dir[oid]
         if self._placement is not None:
             await self._placement.on_node_dead(node.node_id)
 
@@ -411,9 +417,7 @@ class Controller:
     async def publish_locations(self, p):
         node_id = p["node_id"]
         for oid, size in p["objects"]:
-            info = self.object_dir.get(oid)
-            if info is None:
-                info = self.object_dir[oid] = {"nodes": set(), "size": size}
+            info = self._dir_entry(oid)  # merges with placeholder borrows
             info["nodes"].add(node_id)
             info["size"] = size
         return {"ok": True}
@@ -425,12 +429,12 @@ class Controller:
             if info is not None:
                 info["nodes"].discard(node_id)
                 if not info["nodes"]:
-                    del self.object_dir[oid]
+                    self._drop_if_idle(oid)  # keep borrower/owner state
         return {"ok": True}
 
     async def locate_object(self, p):
         info = self.object_dir.get(p["object_id"])
-        if info is None:
+        if info is None or not info["nodes"]:
             return None
         nodes = []
         for nid in info["nodes"]:
@@ -451,6 +455,75 @@ class Controller:
                     await cli.notify("delete_object", {"object_id": oid})
                 except RpcError:
                     pass
+        # Cascade: borrows induced by refs embedded in this object's
+        # payload end with the container (the embedded refs can only be
+        # materialized out of a payload that no longer exists).
+        for emb in info.get("induced", ()):
+            await self.remove_borrower({
+                "object_id": emb, "holder": f"obj:{oid.hex()}"})
+        return {"ok": True}
+
+    # --------------------------------------- distributed reference counting
+    # (ref: src/ray/core_worker/reference_count.h:66 — redesigned around
+    # this controller's centralized object directory: each process reports
+    # only its 0<->1 holder transitions, the controller frees when the
+    # owner has released AND no borrowers remain.)
+    async def owner_release(self, p):
+        """The owning process dropped its last reference."""
+        oid = p["object_id"]
+        info = self.object_dir.get(oid)
+        if info is None:
+            return {"ok": True}  # never materialized or already freed
+        info["owner_released"] = True
+        if not info["borrowers"]:
+            await self.free_object({"object_id": oid})
+        return {"ok": True}
+
+    def _dir_entry(self, oid: ObjectID) -> Dict:
+        """Get-or-create a directory entry.  Borrows may legitimately
+        arrive before the object is published (a ref travels in a task
+        spec while the producer is still sealing); the placeholder keeps
+        the borrow so the eventual publish + owner release can't free the
+        object out from under the borrower."""
+        info = self.object_dir.get(oid)
+        if info is None:
+            info = self.object_dir[oid] = {
+                "nodes": set(), "size": 0,
+                "borrowers": set(), "owner_released": False}
+        return info
+
+    def _drop_if_idle(self, oid: ObjectID) -> None:
+        info = self.object_dir.get(oid)
+        if info is not None and not info["nodes"] \
+                and not info["borrowers"] and not info.get("induced"):
+            del self.object_dir[oid]
+
+    async def add_borrower(self, p):
+        self._dir_entry(p["object_id"])["borrowers"].add(p["holder"])
+        return {"ok": True}
+
+    async def remove_borrower(self, p):
+        oid = p["object_id"]
+        info = self.object_dir.get(oid)
+        if info is None:
+            return {"ok": True}
+        info["borrowers"].discard(p["holder"])
+        if info["owner_released"] and not info["borrowers"]:
+            await self.free_object({"object_id": oid})
+        else:
+            self._drop_if_idle(oid)
+        return {"ok": True}
+
+    async def link_induced_borrows(self, p):
+        """Register borrows held on behalf of refs embedded inside a
+        container object's serialized payload; they are released when the
+        container is freed (free_object cascade)."""
+        container = p["container"]
+        holder = f"obj:{container.hex()}"
+        for emb in p["embedded"]:
+            self._dir_entry(emb)["borrowers"].add(holder)
+        cinfo = self._dir_entry(container)
+        cinfo.setdefault("induced", set()).update(p["embedded"])
         return {"ok": True}
 
     # ---------------------------------------------------------------- pubsub

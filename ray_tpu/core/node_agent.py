@@ -549,8 +549,13 @@ class NodeAgent:
 
     # -------------------------------------------------------- object plane
     async def register_object(self, p):
+        """Producer-side registration.  The producer's copy is the primary
+        copy: pinned until distributed ref counting frees the object, so
+        LRU pressure can never delete the only live copy (ref:
+        object_lifecycle_manager.h primary-copy pinning)."""
         oid, size = p["object_id"], p["size"]
-        evicted = self.directory.register(oid, size)
+        evicted = self.directory.register(
+            oid, size, primary=p.get("primary", True))
         try:
             await self._ctl.call("publish_locations", {
                 "node_id": self.node_id, "objects": [(oid, size)]})
@@ -573,6 +578,12 @@ class NodeAgent:
         ent = self.directory.lookup(oid)
         if ent is not None:
             return {"ok": True, "size": ent.size}
+        if p.get("fail_fast"):
+            # Recovery probes never coalesce: they must answer "gone"
+            # immediately, not wait behind a long-polling pull (and a
+            # normal pull must not inherit a probe's instant failure).
+            return await self._do_pull(oid, p.get("timeout", 30.0),
+                                       fail_fast=True)
         inflight = self._pull_inflight.get(oid)
         if inflight is not None:
             return await asyncio.shield(inflight)
@@ -590,7 +601,11 @@ class NodeAgent:
         finally:
             self._pull_inflight.pop(oid, None)
 
-    async def _do_pull(self, oid: ObjectID, timeout: float) -> Dict:
+    async def _do_pull(self, oid: ObjectID, timeout: float,
+                       fail_fast: bool = False) -> Dict:
+        """``fail_fast`` returns "no locations" immediately instead of
+        polling — the owner uses it to decide whether to reconstruct the
+        object from lineage rather than wait out the timeout."""
         deadline = asyncio.get_event_loop().time() + timeout
         delay = 0.02
         while True:
@@ -620,11 +635,16 @@ class NodeAgent:
                     if data is None:
                         continue
                     self.store.put_raw(oid, data)
-                    self.directory.register(oid, len(data))
+                    # Pulled replica = secondary copy, LRU-evictable.
+                    evicted = self.directory.register(oid, len(data))
                     try:
                         await self._ctl.call("publish_locations", {
                             "node_id": self.node_id,
                             "objects": [(oid, len(data))]})
+                        if evicted:
+                            await self._ctl.call("remove_locations", {
+                                "node_id": self.node_id,
+                                "objects": evicted})
                     except RpcError:
                         pass
                     return {"ok": True, "size": len(data)}
@@ -632,19 +652,26 @@ class NodeAgent:
             ent = self.directory.lookup(oid)
             if ent is not None:
                 return {"ok": True, "size": ent.size}
+            if fail_fast and not (loc and loc["nodes"]):
+                return {"ok": False, "error": "no locations"}
             if asyncio.get_event_loop().time() > deadline:
                 return {"ok": False, "error": "object not found"}
             await asyncio.sleep(delay)
             delay = min(delay * 1.5, 0.5)
 
     async def fetch_raw(self, p):
-        ent = self.directory.lookup(p["object_id"])
+        oid = p["object_id"]
+        ent = self.directory.lookup(oid)
         if ent is None:
             return None
+        # Transient pin: the peer's pull must not race local eviction.
+        self.directory.pin(oid)
         try:
-            return self.store.read_raw(p["object_id"], ent.size)
+            return self.store.read_raw(oid, ent.size)
         except FileNotFoundError:
             return None
+        finally:
+            self.directory.unpin(oid)
 
     async def delete_object(self, p):
         self.directory.delete(p["object_id"])

@@ -8,18 +8,74 @@ and may be passed as arguments to remote calls, which forwards the borrow.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 from .ids import ObjectID
 
 
-class ObjectRef:
-    __slots__ = ("id", "_owner", "_in_band")
+class _RefCollector(threading.local):
+    """Collects ObjectRef ids encountered while pickling a value.
 
-    def __init__(self, object_id: ObjectID, owner: str = "", in_band: bool = False):
+    Activated by the worker around result serialization so refs embedded
+    in a return value can be protected (borrow registration) before the
+    producing frame's own references die — the ownership-handoff window
+    (ref: reference_count.h borrowed-refs protocol)."""
+
+    def __init__(self):
+        self.active: Optional[list] = None
+
+
+_collector = _RefCollector()
+
+
+def collect_embedded_refs():
+    """Context manager: activates collection, yields the id list."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        prev = _collector.active
+        _collector.active = found = []
+        try:
+            yield found
+        finally:
+            _collector.active = prev
+
+    return _cm()
+
+
+class ObjectRef:
+    __slots__ = ("id", "_owner", "_in_band", "_counted")
+
+    def __init__(self, object_id: ObjectID, owner: str = "",
+                 in_band: bool = False, counted: bool = True):
         self.id = object_id
         self._owner = owner
         self._in_band = in_band  # True when created by local-mode put
+        self._counted = counted  # False for internal transient handles
+        if not counted:
+            return
+        from . import runtime
+
+        rt = runtime.get_runtime_quiet()
+        if rt is not None:
+            rt.add_local_ref(object_id)
+
+    def __del__(self):
+        # Lifecycle hook feeding distributed ref counting (ref:
+        # reference_count.h RemoveLocalReference).  Must never raise:
+        # __del__ can fire during interpreter teardown.
+        try:
+            if not self._counted:
+                return
+            from . import runtime
+
+            rt = runtime.get_runtime_quiet()
+            if rt is not None:
+                rt.remove_local_ref(self.id)
+        except Exception:
+            pass
 
     def hex(self) -> str:
         return self.id.hex()
@@ -39,6 +95,8 @@ class ObjectRef:
     def __reduce__(self):
         # Refs are routinely pickled into task args; the receiving runtime
         # re-registers the borrow on deserialization (see worker context).
+        if _collector.active is not None:
+            _collector.active.append(self.id)
         return (ObjectRef, (self.id, self._owner, self._in_band))
 
     def future(self):

@@ -160,14 +160,26 @@ class SharedObjectStore:
         except FileNotFoundError:
             return False
 
+    @staticmethod
+    def _close_or_abandon(seg: shared_memory.SharedMemory) -> None:
+        """Close a mapping, or abandon it if zero-copy views still point
+        into it: detach the handles so neither close() nor __del__ ever
+        touches the exported buffer again.  The mmap object itself stays
+        alive exactly as long as the views do (they hold references), so
+        the views remain valid and teardown is silent."""
+        try:
+            seg.close()
+        except BufferError:
+            seg._buf = None    # noqa: SLF001 — deliberate detach
+            seg._mmap = None   # noqa: SLF001
+        except Exception:
+            pass
+
     def release(self, oid: ObjectID) -> None:
         with self._lock:
             seg = self._mapped.pop(oid, None)
         if seg is not None:
-            try:
-                seg.close()
-            except Exception:
-                pass
+            self._close_or_abandon(seg)
 
     def delete(self, oid: ObjectID) -> None:
         self.release(oid)
@@ -183,43 +195,56 @@ class SharedObjectStore:
     def close(self) -> None:
         with self._lock:
             for seg in self._mapped.values():
-                try:
-                    seg.close()
-                except Exception:
-                    pass
+                self._close_or_abandon(seg)
             self._mapped.clear()
 
 
 class StoreDirectory:
     """Node-agent-side authority over local objects: registration, LRU
     eviction under capacity pressure, pinning (ref: plasma eviction_policy.h
-    + object_lifecycle_manager.h)."""
+    + object_lifecycle_manager.h).
+
+    Pin discipline (ref: ObjectLifecycleManager primary-copy pinning):
+    the *primary* copy — the one sealed by the producer — is pinned for
+    its whole life and released only by an explicit delete (driven by
+    distributed ref counting).  Secondary copies (pulled replicas) are
+    LRU-evictable, but transient pins taken around reads keep a mid-read
+    copy from being unlinked.  Pins are counted, so a read pin on a
+    primary copy doesn't unpin its lifetime pin.
+    """
 
     def __init__(self, store: SharedObjectStore, capacity_bytes: int):
         self._store = store
         self._capacity = capacity_bytes
         self._entries: "OrderedDict[ObjectID, StoredObject]" = OrderedDict()
-        self._pinned: Set[ObjectID] = set()
+        self._pins: Dict[ObjectID, int] = {}
         self._used = 0
         self._lock = threading.Lock()
 
-    def register(self, oid: ObjectID, size: int) -> List[ObjectID]:
-        """Record a sealed object; returns ids evicted to make room."""
+    def register(self, oid: ObjectID, size: int,
+                 primary: bool = False) -> List[ObjectID]:
+        """Record a sealed object; returns ids evicted to make room.
+        ``primary=True`` pins the copy for its lifetime (never evicted;
+        only delete() removes it)."""
         evicted: List[ObjectID] = []
         with self._lock:
             if oid in self._entries:
+                if primary:
+                    self._pins[oid] = self._pins.get(oid, 0) + 1
                 return []
             self._entries[oid] = StoredObject(oid, size, time.time())
             self._entries.move_to_end(oid)
+            if primary:
+                self._pins[oid] = self._pins.get(oid, 0) + 1
             self._used += size
             while self._used > self._capacity:
                 victim = None
                 for vid in self._entries:
-                    if vid != oid and vid not in self._pinned:
+                    if vid != oid and self._pins.get(vid, 0) == 0:
                         victim = vid
                         break
                 if victim is None:
-                    break
+                    break  # everything live is pinned; run over capacity
                 ent = self._entries.pop(victim)
                 self._used -= ent.size
                 evicted.append(victim)
@@ -236,16 +261,20 @@ class StoreDirectory:
 
     def pin(self, oid: ObjectID) -> None:
         with self._lock:
-            self._pinned.add(oid)
+            self._pins[oid] = self._pins.get(oid, 0) + 1
 
     def unpin(self, oid: ObjectID) -> None:
         with self._lock:
-            self._pinned.discard(oid)
+            n = self._pins.get(oid, 0) - 1
+            if n <= 0:
+                self._pins.pop(oid, None)
+            else:
+                self._pins[oid] = n
 
     def delete(self, oid: ObjectID) -> bool:
         with self._lock:
             ent = self._entries.pop(oid, None)
-            self._pinned.discard(oid)
+            self._pins.pop(oid, None)
             if ent is not None:
                 self._used -= ent.size
         if ent is not None:

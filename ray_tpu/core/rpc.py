@@ -329,7 +329,11 @@ class EventLoopThread:
         def _shutdown():
             for task in asyncio.all_tasks(self.loop):
                 task.cancel()
-            self.loop.stop()
+            # Defer the stop two cycles so the cancellations unwind first
+            # (stopping immediately leaves "Task was destroyed but it is
+            # pending" noise at interpreter exit).
+            self.loop.call_soon(
+                lambda: self.loop.call_soon(self.loop.stop))
 
         try:
             self.loop.call_soon_threadsafe(_shutdown)

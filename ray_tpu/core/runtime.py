@@ -32,6 +32,14 @@ def get_runtime() -> "BaseRuntime":
     return rt
 
 
+def get_runtime_quiet() -> Optional["BaseRuntime"]:
+    """Like get_runtime but returns None when uninitialized — used by
+    ObjectRef lifecycle hooks, which must never raise (they run in
+    __init__/__del__, including during unpickling in processes that have
+    no runtime, e.g. the controller)."""
+    return _global_runtime
+
+
 def is_initialized() -> bool:
     return _global_runtime is not None
 
@@ -126,6 +134,14 @@ class BaseRuntime(abc.ABC):
 
     def cancel(self, ref: ObjectRef, force: bool) -> None:
         raise NotImplementedError
+
+    # -- Reference counting hooks (ref: reference_count.h:66) ---------------
+    # No-ops by default; ClusterRuntime implements distributed counting.
+    def add_local_ref(self, object_id) -> None:
+        pass
+
+    def remove_local_ref(self, object_id) -> None:
+        pass
 
     @abc.abstractmethod
     def shutdown(self) -> None: ...

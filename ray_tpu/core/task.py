@@ -109,3 +109,7 @@ class TaskResult:
     returns: List[Tuple[str, Any]] = field(default_factory=list)
     error: Optional[Any] = None  # serialized exception (TaskError)
     worker_log: str = ""
+    # ObjectRef ids embedded in inline return payloads; the executor holds
+    # a transit borrow on each until the owner confirms receipt (ownership
+    # handoff, ref: reference_count.h borrowed-refs protocol).
+    transit_refs: List[ObjectID] = field(default_factory=list)

@@ -112,10 +112,16 @@ class Worker:
         from .object_ref import ObjectRef
 
         vals = []
+        timeout = self.config.arg_pull_timeout_s
         for a in spec.args:
             if a.kind == ArgKind.OBJECT_REF:
-                vals.append(self.runtime.get(
-                    [ObjectRef(a.object_id)], None)[0])
+                # counted=False: the owner's submitted-task hold already
+                # pins the arg for this task's duration — a borrow here
+                # would just be 2 extra controller RPCs per arg.  Bounded
+                # timeout: a lost arg must surface ObjectLostError so the
+                # owner can reconstruct and retry, not hang for hours.
+                ref = ObjectRef(a.object_id, counted=False)
+                vals.append(self.runtime.get([ref], timeout)[0])
             else:
                 vals.append(a.value)
         nkw = len(spec.kwargs_keys)
@@ -134,10 +140,14 @@ class Worker:
                     f"Task {spec.display_name()} declared "
                     f"num_returns={spec.num_returns}, returned "
                     f"{len(values)}")
+        from .object_ref import collect_embedded_refs
+
         entries = []
+        transit: list = []
         oids = spec.return_object_ids()
         for oid, value in zip(oids, values):
-            payload, views = serialization.serialize(value)
+            with collect_embedded_refs() as embedded:
+                payload, views = serialization.serialize(value)
             size = serialization.packed_size(payload, views)
             if size <= self.config.object_inline_max_bytes:
                 buf = bytearray(size)
@@ -150,12 +160,32 @@ class Worker:
                     buf[pos:pos + 8] = n.to_bytes(8, "little"); pos += 8
                     buf[pos:pos + n] = v; pos += n
                 entries.append(("inline", bytes(buf)))
+                if embedded:
+                    # Ownership handoff: hold a transit borrow on each ref
+                    # embedded in the payload until the owner confirms
+                    # receipt (released in _accept_returns) — otherwise
+                    # this frame's refs die and free the objects before
+                    # the owner ever sees them.
+                    holder = f"transit:{spec.task_id.hex()}"
+                    for emb in embedded:
+                        self.runtime.controller_call(
+                            "add_borrower",
+                            {"object_id": emb, "holder": holder})
+                    transit.extend(embedded)
             else:
                 self.runtime.store.seal_parts(oid, payload, views)
                 self.runtime.agent_call(
                     "register_object", {"object_id": oid, "size": size})
+                if embedded:
+                    # Embedded refs live as long as the container payload:
+                    # the controller releases these borrows when the
+                    # container object itself is freed.
+                    self.runtime.controller_call(
+                        "link_induced_borrows",
+                        {"container": oid, "embedded": list(embedded)})
                 entries.append(("store", (size, self.node_id_hex)))
-        return TaskResult(task_id=spec.task_id, ok=True, returns=entries)
+        return TaskResult(task_id=spec.task_id, ok=True, returns=entries,
+                          transit_refs=transit)
 
     def _execute_sync(self, spec: TaskSpec, fn, lease_id: Optional[int],
                       chip_ids: List[int]) -> TaskResult:
