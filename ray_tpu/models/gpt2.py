@@ -39,7 +39,7 @@ class GPT2Config:
     max_seq: int = 1024
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "dense"          # dense | ring | ulysses
+    attn_impl: str = "dense"          # dense | flash | ring | ulysses
     remat: bool = True
     mesh: Any = None                  # jax Mesh for CP shard_map wrappers
     rules: Any = None                 # ShardingRules override
@@ -76,6 +76,17 @@ def _constrain(x, logical, cfg: GPT2Config):
 
 def _attention(cfg: GPT2Config, q, k, v):
     """q,k,v: [B, T, H, D] -> [B, T, H, D]."""
+    if cfg.attn_impl == "flash":
+        # Pallas blockwise kernel (ops/flash_attention.py): no [T, T]
+        # score matrix in HBM.  Measured on v5e at pretraining shapes:
+        # whole-sequence blocks (clamped to 1024) win — per-program
+        # overhead dominates below 512, and a [1024,1024] f32 score
+        # block still fits VMEM comfortably.  Longer sequences stream
+        # in 1024-blocks with causal block-skipping.
+        from ..ops import flash_attention
+
+        return flash_attention(q, k, v, causal=True,
+                               block_q=1024, block_k=1024)
     if cfg.attn_impl == "dense":
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                             preferred_element_type=jnp.float32)
@@ -142,7 +153,7 @@ class GPT2(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden: bool = False):
         cfg = self.cfg
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
@@ -158,6 +169,8 @@ class GPT2(nn.Module):
             x = block(cfg, name=f"h_{i}")(x)
             x = _constrain(x, ("batch", "seq", "embed"), cfg)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        if return_hidden:
+            return x
         logits = jnp.einsum("btd,vd->btv", x, wte.astype(cfg.dtype),
                             preferred_element_type=jnp.float32)
         return _constrain(logits, ("batch", "seq", "vocab"), cfg)
@@ -173,10 +186,46 @@ def gpt2_init(cfg: GPT2Config, rng) -> Any:
     return GPT2(init_cfg).init(rng, tokens)
 
 
-def gpt2_loss_fn(cfg: GPT2Config, params, batch) -> jnp.ndarray:
+def _chunked_xent(x, wte, targets, chunk: int) -> jnp.ndarray:
+    """Cross entropy without materializing [B, T, V] logits in HBM.
+
+    The fp32 logits tensor (~1.6 GB at GPT-2 pretraining shapes) is the
+    biggest single HBM consumer of the step; scanning seq chunks with a
+    rematerialized body keeps only one [B, chunk, V] slab live, and the
+    backward recomputes each chunk's logits instead of reading them back.
+    """
+    b, t, d = x.shape
+    n = t // chunk
+    xs = jnp.moveaxis(x.reshape(b, n, chunk, d), 1, 0)       # [n,b,c,d]
+    ts = jnp.moveaxis(targets.reshape(b, n, chunk), 1, 0)    # [n,b,c]
+
+    @jax.checkpoint
+    def body(acc, xt):
+        xc, tc = xt
+        logits = jnp.einsum("bcd,vd->bcv", xc, wte,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)              # [b,c]
+        tgt = jnp.take_along_axis(logits, tc[..., None],
+                                  axis=-1)[..., 0]
+        return acc + jnp.sum(lse - tgt), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (xs, ts))
+    return total / (b * t)
+
+
+def gpt2_loss_fn(cfg: GPT2Config, params, batch,
+                 loss_chunk: int = 128) -> jnp.ndarray:
     """Next-token cross entropy; batch: {tokens [B, T+1] int32}."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    t = inputs.shape[1]
+    if loss_chunk and t % loss_chunk == 0 and t > loss_chunk \
+            and cfg.mesh is None:
+        # Sharded runs keep the einsum whole so GSPMD can partition the
+        # vocab dim; single-chip runs take the chunked low-HBM path.
+        x = GPT2(cfg).apply(params, inputs, return_hidden=True)
+        wte = params["params"]["wte"].astype(cfg.dtype)
+        return _chunked_xent(x, wte, targets, loss_chunk)
     logits = GPT2(cfg).apply(params, inputs)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -1,0 +1,9 @@
+"""TPU kernels (pallas) for the hot ops.
+
+The compute path of the framework is XLA-compiled jax; these kernels
+cover the places where XLA's fusion leaves HBM bandwidth on the table —
+first of all attention, whose materialized [B,H,T,T] score matrix
+dominates memory traffic at pretraining shapes.
+"""
+
+from .flash_attention import flash_attention  # noqa: F401
