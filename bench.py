@@ -56,8 +56,6 @@ def main() -> None:
     def loss_fn(p, b):
         return gpt2_loss_fn(cfg, p, b, loss_chunk=0)
 
-    from ray_tpu.train.train_step import make_train_step
-
     one_step = make_train_step(loss_fn, optimizer)
     tokens = jax.random.randint(jax.random.PRNGKey(1),
                                 (batch, cfg.max_seq + 1), 0,

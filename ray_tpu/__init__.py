@@ -87,6 +87,15 @@ def init(
     elif mode == "cluster":
         from .core.cluster_runtime import ClusterRuntime
 
+        if address == "auto":
+            from .scripts.cli import resolve_address
+
+            address = resolve_address(cfg)
+            if address is None:
+                raise ConnectionError(
+                    'address="auto" but no running cluster was found on '
+                    "this machine (start one with `python -m ray_tpu "
+                    "start --head`).")
         rt = ClusterRuntime(
             cfg, address=address, num_cpus=num_cpus, num_tpus=num_tpus,
             custom_resources=resources, namespace=namespace)

@@ -1,0 +1,7 @@
+"""``python -m ray_tpu`` — alias for the ``rt`` cluster CLI."""
+
+import sys
+
+from ray_tpu.scripts.cli import main
+
+sys.exit(main())

@@ -78,7 +78,7 @@ class CPUGroup:
         self._store = store
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind(("127.0.0.1", 0))
+        self._listener.bind(("0.0.0.0", 0))
         self._listener.listen(world_size + 4)
         self._port = self._listener.getsockname()[1]
         self._peers: Dict[int, socket.socket] = {}
@@ -88,7 +88,10 @@ class CPUGroup:
             target=self._accept_loop, daemon=True)
         self._accept_thread.start()
         self._closed = False
-        store.set(f"col/{group_name}/{rank}", f"127.0.0.1:{self._port}")
+        from ray_tpu.core.net import get_node_ip_address
+
+        store.set(f"col/{group_name}/{rank}",
+                  f"{get_node_ip_address()}:{self._port}")
         if rank == 0:
             self._await_hub_connections()
         else:

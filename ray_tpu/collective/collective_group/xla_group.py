@@ -49,11 +49,13 @@ def _ensure_jax_world(store, group_name: str, world_size: int,
     if rank == 0:
         import socket
 
+        from ray_tpu.core.net import get_node_ip_address
+
         s = socket.socket()
-        s.bind(("127.0.0.1", 0))
+        s.bind(("", 0))
         port = s.getsockname()[1]
         s.close()
-        coord = f"127.0.0.1:{port}"
+        coord = f"{get_node_ip_address()}:{port}"
         store.set(key, coord)
     else:
         deadline = time.time() + 120

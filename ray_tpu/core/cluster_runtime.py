@@ -180,9 +180,12 @@ class ClusterRuntime(BaseRuntime):
             info = probe.run(self._probe(cli))
             session = info["session"]
             nodes = info["nodes"]
+            from .net import host_of, is_local_address
+
             agent_addr = None
             for n in nodes:
-                if n["alive"] and n["agent_addr"].startswith("127.0.0.1"):
+                if n["alive"] and is_local_address(
+                        host_of(n["agent_addr"])):
                     agent_addr = n["agent_addr"]
                     break
             if agent_addr is None:

@@ -652,10 +652,10 @@ def main() -> None:
         ctl = Controller(config, args.session)
         port = await ctl.run(args.port, driver_pid=args.driver_pid)
         if args.ready_fd >= 0:
-            os.write(args.ready_fd, f"{port}\n".encode())
+            os.write(args.ready_fd, f"{ctl.server.address}\n".encode())
             os.close(args.ready_fd)
         else:
-            print(f"CONTROLLER_PORT={port}", flush=True)
+            print(f"CONTROLLER_ADDRESS={ctl.server.address}", flush=True)
         await ctl.wait_shutdown()
 
     try:

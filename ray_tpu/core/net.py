@@ -1,0 +1,82 @@
+"""Node address detection and advertising.
+
+Role-equivalent to the reference's ray.util.get_node_ip_address (ref:
+python/ray/_private/services.py node_ip_address_from_perspective) — every
+service binds all interfaces and advertises a routable address so a
+cluster can span hosts (round-1 gap: every coordinator advertised
+127.0.0.1, which is dead on a real TPU pod).
+
+Resolution order:
+1. ``RT_NODE_IP`` env var / ``node_ip`` config flag (explicit operator
+   choice, e.g. the ICI-adjacent NIC on a multi-NIC TPU VM).
+2. UDP-connect trick: connecting a datagram socket picks the interface
+   the kernel would route externally — no packet is sent, so this works
+   with zero egress.
+3. hostname resolution, skipping loopback.
+4. 127.0.0.1 (single-host fallback; everything still works locally).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import socket
+
+
+@functools.lru_cache(maxsize=None)
+def _detect_ip() -> str:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 53))
+        ip = s.getsockname()[0]
+        if ip and not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    finally:
+        s.close()
+    try:
+        ip = socket.gethostbyname(socket.gethostname())
+        if ip and not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    return "127.0.0.1"
+
+
+def get_node_ip_address() -> str:
+    """The address this node advertises to the rest of the cluster."""
+    explicit = os.environ.get("RT_NODE_IP", "").strip()
+    if explicit:
+        return explicit
+    return _detect_ip()
+
+
+def is_local_address(host: str) -> bool:
+    """True if ``host`` names this machine (loopback or our node IP)."""
+    if host in ("127.0.0.1", "localhost", "::1", "0.0.0.0", ""):
+        return True
+    if host == get_node_ip_address():
+        return True
+    try:
+        return socket.gethostbyname(host).startswith("127.")
+    except OSError:
+        return False
+
+
+def host_of(address: str) -> str:
+    return address.rsplit(":", 1)[0]
+
+
+def port_of(address: str) -> int:
+    return int(address.rsplit(":", 1)[1])
+
+
+def free_port(host: str = "") -> int:
+    """An OS-assigned free TCP port (racy by nature; callers that can
+    should bind port 0 directly instead)."""
+    s = socket.socket()
+    s.bind((host if host and not is_local_address(host) else "", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

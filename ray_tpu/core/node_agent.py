@@ -399,11 +399,23 @@ class NodeAgent:
             if target is not None:
                 return {"ok": False, "retry_at": target}
         if not self.total.covers(demand) and p.get("pg_id") is None:
+            # This node can never run it.  Infeasibility is a CLUSTER
+            # property (ref: cluster_task_manager.h:42 infeasible queue):
+            # forward to any node whose TOTAL covers the demand — its
+            # available may just be stale in the controller view — and
+            # only error when no such node exists.  Affinity-bound and
+            # hop-capped leases (no_spill) must NOT be forwarded: running
+            # elsewhere would violate the placement constraint.
+            if not p.get("no_spill") and strategy in ("DEFAULT", "SPREAD"):
+                target = await self._pick_remote(demand, strategy,
+                                                 by_total=True)
+                if target is not None:
+                    return {"ok": False, "retry_at": target}
             return {"ok": False,
                     "infeasible": True,
                     "error": f"resources {demand.amounts} can never be "
-                             f"satisfied by node {self.node_id.hex()[:8]} "
-                             f"(total {self.total.amounts})"}
+                             f"satisfied by any alive node "
+                             f"(this node total {self.total.amounts})"}
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self.pending.append(_PendingLease(p, fut))
         timeout = p.get("queue_timeout") or 3600.0
@@ -413,12 +425,16 @@ class NodeAgent:
             return {"ok": False, "error": "lease queue timeout"}
 
     async def _pick_remote(self, demand: ResourceSet,
-                           strategy: str) -> Optional[str]:
+                           strategy: str,
+                           by_total: bool = False) -> Optional[str]:
         """Hybrid policy: stay local under the utilization threshold, else
         pick the best remote with available capacity (ref:
-        policy/hybrid_scheduling_policy.h:29-50)."""
+        policy/hybrid_scheduling_policy.h:29-50).  ``by_total`` relaxes
+        the filter to nodes whose total capacity covers the demand — used
+        for demands this node can never satisfy, where the target should
+        queue rather than reject."""
         local_util = self.available.utilization(self.total)
-        if strategy == "DEFAULT" and \
+        if not by_total and strategy == "DEFAULT" and \
                 local_util < self.config.scheduler_spread_threshold \
                 and self.total.covers(demand):
             return None  # queue locally; we're not saturated
@@ -432,7 +448,7 @@ class NodeAgent:
                 continue
             avail = ResourceSet(dict(info["available"]))
             total = ResourceSet(dict(info["total"]))
-            if avail.covers(demand):
+            if (total if by_total else avail).covers(demand):
                 candidates.append((avail.utilization(total), str(nid.hex()),
                                    info["agent_addr"]))
         if not candidates:
@@ -849,10 +865,11 @@ def main() -> None:
         port = await agent.start(args.port)
         if args.ready_fd >= 0:
             os.write(args.ready_fd,
-                     f"{port} {agent.node_id.hex()}\n".encode())
+                     f"{agent.server.address} "
+                     f"{agent.node_id.hex()}\n".encode())
             os.close(args.ready_fd)
         else:
-            print(f"AGENT_PORT={port}", flush=True)
+            print(f"AGENT_ADDRESS={agent.server.address}", flush=True)
         await agent.wait_shutdown()
 
     try:

@@ -71,11 +71,13 @@ def log_dir_of(config: RuntimeConfig, session: str) -> str:
 
 
 def start_controller(config: RuntimeConfig, session: str,
-                     driver_pid: int = 0
+                     driver_pid: int = 0, port: int = 0
                      ) -> Tuple[subprocess.Popen, str]:
     r_fd, w_fd = os.pipe()
     args = [sys.executable, "-u", "-m", "ray_tpu.core.controller",
             "--session", session, "--ready-fd", str(w_fd)]
+    if port:
+        args += ["--port", str(port)]
     if driver_pid:
         args += ["--driver-pid", str(driver_pid)]
     proc = _spawn(
@@ -83,7 +85,7 @@ def start_controller(config: RuntimeConfig, session: str,
         os.path.join(log_dir_of(config, session), "controller.log"), w_fd)
     os.close(w_fd)
     line = _read_ready(r_fd, proc, "controller")
-    return proc, f"127.0.0.1:{int(line.split()[0])}"
+    return proc, line.split()[0]
 
 
 def start_node_agent(
@@ -111,4 +113,4 @@ def start_node_agent(
     os.close(w_fd)
     line = _read_ready(r_fd, proc, "node agent")
     parts = line.split()
-    return proc, f"127.0.0.1:{int(parts[0])}", parts[1]
+    return proc, parts[0], parts[1]

@@ -69,8 +69,25 @@ class RpcServer:
     ERROR frame that re-raises at the caller as ``RemoteCallError``.
     """
 
-    def __init__(self, host: str = "127.0.0.1"):
-        self._host = host
+    def __init__(self, host: Optional[str] = None):
+        # Bind and advertise the routable node IP (ref: services.py
+        # node_ip_address_from_perspective — round-1 advertised loopback,
+        # which cannot span hosts).  Binding the single advertised
+        # interface, not 0.0.0.0, limits exposure: frames are
+        # cloudpickle-deserialized, so like the reference's gRPC plane
+        # this protocol is only safe on a trusted cluster network
+        # (RT_BIND_ALL=1 opts into wildcard bind for multi-NIC setups).
+        from .net import get_node_ip_address
+
+        if host is not None:
+            self._bind_host = self._host = host
+        else:
+            self._host = get_node_ip_address()
+            import os as _os
+
+            self._bind_host = ("0.0.0.0"
+                               if _os.environ.get("RT_BIND_ALL") == "1"
+                               else self._host)
         self._handlers: Dict[str, Callable[[Any], Awaitable[Any]]] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self.port: int = 0
@@ -86,8 +103,16 @@ class RpcServer:
         self._conn_lost_cb = cb
 
     async def start(self, port: int = 0) -> int:
-        self._server = await asyncio.start_server(
-            self._serve_conn, self._host, port)
+        try:
+            self._server = await asyncio.start_server(
+                self._serve_conn, self._bind_host, port)
+        except OSError:
+            if self._bind_host in ("0.0.0.0", "127.0.0.1"):
+                raise
+            # Advertised address not locally bindable (e.g. RT_NODE_IP
+            # points at a forwarded/NAT address): fall back to wildcard.
+            self._server = await asyncio.start_server(
+                self._serve_conn, "0.0.0.0", port)
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port
 

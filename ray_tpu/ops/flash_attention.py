@@ -304,7 +304,7 @@ def flash_attention(q, k, v, *, causal: bool = True,
     """
     b, t, h, d = q.shape
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu",)
+        interpret = jax.default_backend() not in ("tpu", "axon")
     block_q = min(block_q, t)
     block_k = min(block_k, t)
     if t % block_q or t % block_k:

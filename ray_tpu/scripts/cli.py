@@ -1,0 +1,273 @@
+"""``rt`` — the cluster operations CLI.
+
+Role-equivalent to the reference's ``ray`` CLI (ref:
+python/ray/scripts/scripts.py:654 ``ray start``): brings a head node up on
+one machine, joins worker machines to it by address, and inspects/stops
+the running cluster.  This is the multi-host entry point — ``rt start
+--head`` on the coordinator VM, ``rt start --address=<head>:<port>`` on
+every other TPU VM, then any driver connects with
+``ray_tpu.init(address=...)``.
+
+Run as ``python -m ray_tpu.scripts.cli`` (alias: ``python -m ray_tpu``).
+
+State: each machine records the processes it started under
+``<session_dir_root>/<session>/cluster.json`` and points
+``<session_dir_root>/latest`` at the newest session, so ``rt stop`` /
+``address="auto"`` need no arguments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+import time
+from typing import Dict, List, Optional
+
+DEFAULT_PORT = 6380
+
+
+# --------------------------------------------------------------- state file
+def _state_path(config, session: str) -> str:
+    return os.path.join(config.session_dir_root, session, "cluster.json")
+
+
+def _latest_path(config) -> str:
+    return os.path.join(config.session_dir_root, "latest")
+
+
+def _record(config, session: str, *, address: str,
+            pids: List[int], head: bool) -> None:
+    path = _state_path(config, session)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    state = {"session": session, "address": address, "head": head,
+             "pids": []}
+    if os.path.exists(path):
+        with open(path) as f:
+            state = json.load(f)
+    state["pids"].extend(pids)
+    state["head"] = state.get("head", False) or head
+    with open(path, "w") as f:
+        json.dump(state, f)
+    tmp = _latest_path(config) + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(session)
+    os.replace(tmp, _latest_path(config))
+
+
+def _load_latest(config) -> Optional[Dict]:
+    try:
+        with open(_latest_path(config)) as f:
+            session = f.read().strip()
+        with open(_state_path(config, session)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def resolve_address(config=None, address: Optional[str] = None
+                    ) -> Optional[str]:
+    """Resolve ``auto``/None to this machine's recorded cluster address
+    (the ``ray.init("auto")`` convention)."""
+    if address and address != "auto":
+        return address
+    env = os.environ.get("RT_ADDRESS", "").strip()
+    if env and env != "auto":
+        return env
+    if config is None:
+        from ray_tpu.core.config import RuntimeConfig
+
+        config = RuntimeConfig.from_env()
+    state = _load_latest(config)
+    return state["address"] if state else None
+
+
+# ------------------------------------------------------------------- rpc
+def _call(address: str, method: str, payload=None, timeout: float = 10.0):
+    from ray_tpu.core.rpc import RpcClient
+
+    async def _go():
+        cli = RpcClient(address, connect_timeout=timeout)
+        try:
+            return await cli.call(method, payload or {})
+        finally:
+            await cli.close()
+
+    return asyncio.run(_go())
+
+
+# ------------------------------------------------------------- subcommands
+def cmd_start(args) -> int:
+    from ray_tpu.core import node_launcher
+    from ray_tpu.core.config import RuntimeConfig
+
+    if args.node_ip:
+        os.environ["RT_NODE_IP"] = args.node_ip
+    config = RuntimeConfig.from_env()
+    resources = json.loads(args.resources) if args.resources else None
+
+    if args.head and args.address:
+        print("error: pass --head OR --address, not both", file=sys.stderr)
+        return 2
+    pids: List[int] = []
+    if args.head:
+        session = args.session or f"session_{int(time.time())}_{os.getpid()}"
+        proc, ctl_addr = node_launcher.start_controller(
+            config, session, port=args.port)
+        pids.append(proc.pid)
+    else:
+        if not args.address:
+            print("error: need --head or --address=<head_host:port>",
+                  file=sys.stderr)
+            return 2
+        ctl_addr = args.address
+        pong = _call(ctl_addr, "ping")
+        session = pong["session"]
+
+    agent_proc, agent_addr, node_id = node_launcher.start_node_agent(
+        config, session, ctl_addr,
+        num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+        custom_resources=resources, is_head=args.head,
+        tag="head" if args.head else f"join-{os.getpid()}")
+    pids.append(agent_proc.pid)
+    _record(config, session, address=ctl_addr, pids=pids, head=args.head)
+
+    if args.head:
+        print(f"Started head node.\n"
+              f"  controller: {ctl_addr}\n"
+              f"  node agent: {agent_addr} ({node_id[:12]})\n\n"
+              f"Join other machines with:\n"
+              f"  python -m ray_tpu.scripts.cli start "
+              f"--address={ctl_addr}\n\n"
+              f"Connect a driver with:\n"
+              f"  ray_tpu.init(address=\"{ctl_addr}\")")
+    else:
+        print(f"Joined cluster at {ctl_addr}.\n"
+              f"  node agent: {agent_addr} ({node_id[:12]})")
+    if args.block:
+        try:
+            while agent_proc.poll() is None:
+                time.sleep(1.0)
+        except KeyboardInterrupt:
+            pass
+        return agent_proc.returncode or 0
+    return 0
+
+
+def cmd_status(args) -> int:
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found (no --address and no local "
+              "session state).", file=sys.stderr)
+        return 1
+    pong = _call(address, "ping")
+    nodes = _call(address, "list_nodes")
+    print(f"Cluster {pong['session']} @ {address}")
+    alive = [n for n in nodes if n["alive"]]
+    print(f"Nodes: {len(alive)} alive / {len(nodes)} total")
+    for n in nodes:
+        state = "ALIVE" if n["alive"] else "DEAD "
+        head = " (head)" if n.get("is_head") else ""
+        res = ", ".join(f"{k}={v:g}" for k, v in
+                        sorted(n.get("resources", {}).items()))
+        avail = ", ".join(f"{k}={v:g}" for k, v in
+                          sorted(n.get("available", {}).items()))
+        nid = n["node_id"]
+        nid = nid.hex() if hasattr(nid, "hex") else str(nid)
+        print(f"  {state} {nid[:12]} @ {n['agent_addr']}{head}")
+        print(f"         total: {res or '-'}")
+        print(f"         avail: {avail or '-'}")
+    return 0
+
+
+def cmd_stop(args) -> int:
+    from ray_tpu.core.config import RuntimeConfig
+
+    config = RuntimeConfig.from_env()
+    state = _load_latest(config)
+    if state is None:
+        print("No local cluster state.", file=sys.stderr)
+        return 1
+    if state.get("head") and not args.local_only:
+        try:
+            _call(state["address"], "cluster_shutdown", timeout=5.0)
+        except Exception:
+            pass  # controller may already be gone; fall through to kill
+    deadline = time.time() + 10.0
+    for pid in state.get("pids", []):
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            continue
+    killed = 0
+    for pid in state.get("pids", []):
+        while time.time() < deadline:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.1)
+        else:
+            try:
+                os.kill(pid, signal.SIGKILL)
+                killed += 1
+            except (ProcessLookupError, PermissionError):
+                pass
+    try:
+        os.remove(_state_path(config, state["session"]))
+        os.remove(_latest_path(config))
+    except OSError:
+        pass
+    print(f"Stopped {len(state.get('pids', []))} local process(es)"
+          + (f" ({killed} force-killed)" if killed else "") + ".")
+    return 0
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="rt", description="ray_tpu cluster CLI")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("start", help="start a head node or join a cluster")
+    sp.add_argument("--head", action="store_true",
+                    help="start the controller + head agent here")
+    sp.add_argument("--address", default="",
+                    help="controller address to join (host:port)")
+    sp.add_argument("--port", type=int, default=DEFAULT_PORT,
+                    help=f"controller port for --head "
+                         f"(default {DEFAULT_PORT}, 0 = ephemeral)")
+    sp.add_argument("--node-ip", default="",
+                    help="address this node advertises (default: auto)")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--resources", default="",
+                    help='custom resources JSON, e.g. \'{"slice": 1}\'')
+    sp.add_argument("--session", default="",
+                    help="session name override (head only)")
+    sp.add_argument("--block", action="store_true",
+                    help="stay in the foreground until the agent exits")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("status", help="show cluster nodes and resources")
+    sp.add_argument("--address", default="",
+                    help="controller address (default: local state)")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("stop", help="stop locally-started processes")
+    sp.add_argument("--local-only", action="store_true",
+                    help="kill local processes without cluster shutdown")
+    sp.set_defaults(fn=cmd_stop)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = _build_parser()
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,7 +70,7 @@ class HTTPProxy:
             app.router.add_route("*", "/{tail:.*}", handler)
             runner = web.AppRunner(app)
             loop.run_until_complete(runner.setup())
-            site = web.TCPSite(runner, "127.0.0.1", self._port)
+            site = web.TCPSite(runner, "0.0.0.0", self._port)
             loop.run_until_complete(site.start())
             self._actual_port = site._server.sockets[0].getsockname()[1]
             self._ready.set()

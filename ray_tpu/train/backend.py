@@ -39,11 +39,13 @@ class JaxBackend(Backend):
 
             rt = _rt.get_runtime()
             if rank == 0:
+                from ray_tpu.core.net import get_node_ip_address
+
                 s = socket.socket()
-                s.bind(("127.0.0.1", 0))
+                s.bind(("", 0))
                 port = s.getsockname()[1]
                 s.close()
-                coord = f"127.0.0.1:{port}"
+                coord = f"{get_node_ip_address()}:{port}"
                 rt.controller_call("kv_put", {"key": key,
                                               "value": coord.encode()})
             else:
@@ -98,11 +100,27 @@ class TorchBackend(Backend):
 
     def on_start(self, worker_group, run_id: str) -> None:
         num = worker_group.num_workers
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        master = f"127.0.0.1:{port}"
+
+        # The rendezvous master must live on rank 0's host (ref:
+        # train/torch/config.py _setup_torch_process_group — the store
+        # binds on worker 0, not the driver).
+        def _pick_master():
+            import socket as _socket
+
+            from ray_tpu.core.net import get_node_ip_address
+
+            s = _socket.socket()
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return f"{get_node_ip_address()}:{port}"
+
+        from ..core import serialization
+
+        pick = serialization.dumps_code(_pick_master)
+        w0 = worker_group.workers[0]
+        master = ray_tpu.get(w0.actor.run.remote(pick, (), {}),
+                             timeout=60)
 
         def _init(rank: int, world: int, addr: str):
             import os
