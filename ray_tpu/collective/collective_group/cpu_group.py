@@ -76,9 +76,16 @@ class CPUGroup:
         self.world_size = world_size
         self.rank = rank
         self._store = store
+        from ray_tpu.core.net import get_node_ip_address
+
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind(("0.0.0.0", 0))
+        # Bind only the advertised interface (frames are pickled — same
+        # trust model and rationale as RpcServer's single-interface bind).
+        try:
+            self._listener.bind((get_node_ip_address(), 0))
+        except OSError:
+            self._listener.bind(("0.0.0.0", 0))
         self._listener.listen(world_size + 4)
         self._port = self._listener.getsockname()[1]
         self._peers: Dict[int, socket.socket] = {}
@@ -88,8 +95,6 @@ class CPUGroup:
             target=self._accept_loop, daemon=True)
         self._accept_thread.start()
         self._closed = False
-        from ray_tpu.core.net import get_node_ip_address
-
         store.set(f"col/{group_name}/{rank}",
                   f"{get_node_ip_address()}:{self._port}")
         if rank == 0:

@@ -47,6 +47,12 @@ def _merge_options(base: Dict[str, Any], **updates) -> Dict[str, Any]:
     for k, v in updates.items():
         if k not in _DEFAULT_OPTIONS:
             raise TypeError(f"Unknown option {k!r}")
+        if k == "runtime_env" and v:
+            # Validate eagerly so a bad env raises here, in the caller's
+            # thread, not inside the async submit path.
+            from .. import runtime_env as _renv
+
+            _renv.normalize(v)
         out[k] = v
     return out
 

@@ -500,6 +500,42 @@ class ClusterRuntime(BaseRuntime):
             self._accept_returns(spec, result)
             return
 
+    async def _runtime_env_payload(self, spec: TaskSpec):
+        """Package + upload the task's runtime_env once per driver; the
+        lease payload carries only the small wire spec (ref: worker
+        pool keyed by runtime-env hash, worker_pool.h:216)."""
+        raw = getattr(spec, "runtime_env", None)
+        if not raw:
+            return None
+        import json as _json
+
+        cache = getattr(self, "_renv_cache", None)
+        if cache is None:
+            cache = self._renv_cache = {}
+        key = _json.dumps(raw, sort_keys=True)
+        if key in cache:
+            return cache[key]
+        from .. import runtime_env as renv
+
+        try:
+            wire, blobs = renv.package(renv.normalize(raw) or {})
+        except (ValueError, TypeError) as e:
+            # Surface as a task failure (the submit loop's except clauses
+            # resolve the returns); never let it escape the io-loop task,
+            # which would leave the ObjectRef unresolved forever.
+            raise RemoteCallError(e) from None
+        if len(wire) <= 1:  # only the hash of an empty env
+            cache[key] = None
+            return None
+        for kv_key, data in blobs.items():
+            existing = await self._ctl.call("kv_keys",
+                                            {"prefix": kv_key})
+            if not existing:
+                await self._ctl.call("kv_put",
+                                     {"key": kv_key, "value": data})
+        cache[key] = wire
+        return wire
+
     async def _lease_and_push(self, spec: TaskSpec,
                               sub: _Submission) -> TaskResult:
         payload = {
@@ -507,6 +543,9 @@ class ClusterRuntime(BaseRuntime):
             "strategy": spec.scheduling.kind,
             "request_id": sub.request_id,
         }
+        renv_wire = await self._runtime_env_payload(spec)
+        if renv_wire is not None:
+            payload["runtime_env"] = renv_wire
         if spec.scheduling.kind == "PLACEMENT_GROUP":
             payload["pg_id"] = spec.scheduling.placement_group_id
             payload["bundle_index"] = spec.scheduling.bundle_index
@@ -671,6 +710,9 @@ class ClusterRuntime(BaseRuntime):
                 "strategy": spec.scheduling.kind,
                 "is_actor": True, "actor_id": spec.actor_id,
             }
+            renv_wire = await self._runtime_env_payload(spec)
+            if renv_wire is not None:
+                payload["runtime_env"] = renv_wire
             if spec.scheduling.kind == "PLACEMENT_GROUP":
                 payload["pg_id"] = spec.scheduling.placement_group_id
                 payload["bundle_index"] = spec.scheduling.bundle_index

@@ -73,10 +73,10 @@ def port_of(address: str) -> int:
 
 
 def free_port(host: str = "") -> int:
-    """An OS-assigned free TCP port (racy by nature; callers that can
-    should bind port 0 directly instead)."""
+    """An OS-assigned free TCP port on a local interface (racy by
+    nature; callers that can should bind port 0 directly instead)."""
     s = socket.socket()
-    s.bind((host if host and not is_local_address(host) else "", 0))
+    s.bind((host if host and is_local_address(host) else "", 0))
     port = s.getsockname()[1]
     s.close()
     return port

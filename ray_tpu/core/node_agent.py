@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import itertools
+import json
 import logging
 import os
 import signal
@@ -48,6 +49,9 @@ class WorkerEntry:
     state: str = "idle"  # starting | idle | leased | actor | dead
     actor_id: Optional[ActorID] = None
     lease_id: Optional[int] = None
+    # Runtime-env identity: a worker only serves leases with a matching
+    # env hash (ref: worker_pool.h:216 PopWorker runtime-env keying).
+    env_hash: str = ""
 
 
 @dataclass
@@ -183,11 +187,10 @@ class NodeAgent:
                     await self._on_worker_exit(w)
             # Workers that died before registering.
             pending = getattr(self, "_pending_spawns", {})
-            for pid, proc in list(pending.items()):
+            for pid, (proc, env_hash) in list(pending.items()):
                 if proc.poll() is not None:
                     pending.pop(pid, None)
-                    self._starting_workers = max(
-                        0, self._starting_workers - 1)
+                    self._starting_done(env_hash)
                     self._worker_ready.set()
                     logger.warning("worker pid %s died before registering "
                                    "(code %s)", pid, proc.returncode)
@@ -211,7 +214,7 @@ class NodeAgent:
         logger.info("worker %s exited (state=%s)", w.pid, prev_state)
 
     # --------------------------------------------------------- worker pool
-    def _spawn_worker(self) -> None:
+    def _spawn_worker(self, runtime_env: Optional[Dict] = None) -> None:
         env = dict(os.environ)
         env.update(self.config.env_overrides())
         env.update({
@@ -220,6 +223,11 @@ class NodeAgent:
             "RT_AGENT_ADDR": self.server.address,
             "RT_NODE_ID": self.node_id.hex(),
         })
+        env_hash = ""
+        if runtime_env:
+            env_hash = runtime_env.get("hash", "")
+            env.update(runtime_env.get("env_vars", {}))
+            env["RT_RUNTIME_ENV"] = json.dumps(runtime_env)
         log_dir = os.path.join(self.config.session_dir_root, self.session,
                                "logs")
         os.makedirs(log_dir, exist_ok=True)
@@ -234,15 +242,26 @@ class NodeAgent:
         out.close()
         self._spawned_procs.append(proc)
         self._pending_spawns = getattr(self, "_pending_spawns", {})
-        self._pending_spawns[proc.pid] = proc
+        self._pending_spawns[proc.pid] = (proc, env_hash)
+        by_env = getattr(self, "_starting_by_env", None)
+        if by_env is None:
+            by_env = self._starting_by_env = {}
+        by_env[env_hash] = by_env.get(env_hash, 0) + 1
+
+    def _starting_done(self, env_hash: str) -> None:
+        self._starting_workers = max(0, self._starting_workers - 1)
+        by_env = getattr(self, "_starting_by_env", {})
+        if env_hash in by_env:
+            by_env[env_hash] = max(0, by_env[env_hash] - 1)
 
     async def register_worker(self, p):
+        pending = getattr(self, "_pending_spawns", {}).pop(
+            p["pid"], (None, ""))
         w = WorkerEntry(
             worker_id=p["worker_id"], addr=p["addr"], pid=p["pid"],
-            proc=getattr(self, "_pending_spawns", {}).pop(p["pid"], None),
-            state="idle")
+            proc=pending[0], state="idle", env_hash=pending[1])
         self.workers[w.worker_id] = w
-        self._starting_workers = max(0, self._starting_workers - 1)
+        self._starting_done(w.env_hash)
         self._idle_q.append(w)
         self._worker_ready.set()
         self._kick_scheduler()
@@ -257,24 +276,45 @@ class NodeAgent:
             return cap
         return max(int(self.total.get("CPU")) * 4, 16)
 
-    async def _acquire_worker(self) -> Optional[WorkerEntry]:
+    async def _acquire_worker(self, runtime_env: Optional[Dict] = None
+                              ) -> Optional[WorkerEntry]:
         # Spawns are bounded by live demand (waiting acquirers), not by the
         # wake-up rate — otherwise every near-miss wake-up forks another
-        # interpreter and a 1-core host death-spirals.
-        self._num_acquirers = getattr(self, "_num_acquirers", 0) + 1
+        # interpreter and a 1-core host death-spirals.  Both counters are
+        # per runtime-env hash: a worker warming up for env A must not
+        # satisfy the spawn budget of a request for env B.
+        want = (runtime_env or {}).get("hash", "")
+        acq = getattr(self, "_acquirers_by_env", None)
+        if acq is None:
+            acq = self._acquirers_by_env = {}
+        acq[want] = acq.get(want, 0) + 1
         deadline = asyncio.get_event_loop().time() + \
             self.config.worker_start_timeout_s
         try:
             while True:
-                if self._idle_q:
-                    w = self._idle_q.pop(0)
-                    if w.state == "idle":
-                        return w
+                match = next((w for w in self._idle_q
+                              if w.env_hash == want), None)
+                if match is not None:
+                    self._idle_q.remove(match)
+                    if match.state == "idle":
+                        return match
                     continue
+                starting = getattr(self, "_starting_by_env", {}) \
+                    .get(want, 0)
                 active = len(self.workers) + self._starting_workers
-                if self._starting_workers < self._num_acquirers and \
-                        active < self._max_workers():
-                    self._spawn_worker()
+                if starting < acq[want]:
+                    if active >= self._max_workers():
+                        # Pool full of mismatched-env workers: retire an
+                        # idle one to make room (ref: worker_pool.cc
+                        # idle-worker eviction on env mismatch).
+                        victim = next((w for w in self._idle_q
+                                       if w.env_hash != want), None)
+                        if victim is not None:
+                            self._idle_q.remove(victim)
+                            await self._retire_worker(victim)
+                    if len(self.workers) + self._starting_workers \
+                            < self._max_workers():
+                        self._spawn_worker(runtime_env)
                 self._worker_ready.clear()
                 remaining = deadline - asyncio.get_event_loop().time()
                 if remaining <= 0:
@@ -285,7 +325,18 @@ class NodeAgent:
                 except asyncio.TimeoutError:
                     return None
         finally:
-            self._num_acquirers -= 1
+            acq[want] -= 1
+
+    async def _retire_worker(self, w: WorkerEntry) -> None:
+        w.state = "dead"
+        self.workers.pop(w.worker_id, None)
+        try:
+            cli = RpcClient(w.addr, connect_timeout=2.0)
+            await asyncio.wait_for(cli.call("exit", {}), timeout=5.0)
+            await cli.close()
+        except (RpcError, asyncio.TimeoutError, OSError):
+            if w.proc is not None:
+                w.proc.terminate()
 
     # ----------------------------------------------------------- scheduling
     def _kick_scheduler(self) -> None:
@@ -364,7 +415,7 @@ class NodeAgent:
                 return None  # chips pinned by blocked leases; stay queued
             chip_ids = self.free_chips[:n_tpu]
             self.free_chips = self.free_chips[n_tpu:]
-        w = await self._acquire_worker()
+        w = await self._acquire_worker(payload.get("runtime_env"))
         if w is None:
             _refund()
             return None

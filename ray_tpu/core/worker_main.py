@@ -81,6 +81,7 @@ class Worker:
                       "agent": self.agent_addr},
             _job_id=JobID.from_int(0))
         runtime_mod.set_runtime(self.runtime)
+        await self._setup_runtime_env()
         agent = RpcClient(self.agent_addr,
                           tag=f"worker-{self.worker_id.hex()[:8]}",
                           connect_timeout=10.0)
@@ -90,6 +91,51 @@ class Worker:
             "pid": os.getpid()})
         self._agent = agent
         asyncio.ensure_future(self._watch_agent())
+
+    async def _setup_runtime_env(self) -> None:
+        """Materialize working_dir / py_modules before any user code can
+        run (env_vars were set by the agent at spawn).  Packages come
+        from the controller KV; extraction is content-addressed and
+        shared across workers on this node (ref:
+        python/ray/_private/runtime_env/working_dir.py)."""
+        raw = os.environ.get("RT_RUNTIME_ENV")
+        if not raw:
+            return
+        import json
+
+        from .. import runtime_env as renv
+
+        spec = json.loads(raw)
+        if not (spec.get("working_dir_pkg")
+                or spec.get("py_modules_pkgs")):
+            return
+        ctl = RpcClient(self.controller_addr, connect_timeout=10.0)
+        try:
+            root = os.path.join(self.config.session_dir_root, self.session,
+                                "runtime_envs")
+            os.makedirs(root, exist_ok=True)
+            # Fetch only packages not already extracted on this node —
+            # the content-addressed dir is the cross-worker cache.
+            blobs = {}
+            for digest in ([spec.get("working_dir_pkg")] if
+                           spec.get("working_dir_pkg") else []) + \
+                    [e["pkg"] for e in spec.get("py_modules_pkgs", [])]:
+                if os.path.isdir(os.path.join(root, digest)):
+                    continue
+                key = f"runtime_env/pkg/{digest}"
+                blobs[key] = await ctl.call("kv_get", {"key": key})
+
+            def kv_get(key):
+                return blobs.get(key)
+
+            cwd, paths = renv.materialize(spec, kv_get, root)
+            for p in reversed(paths):
+                if p not in sys.path:
+                    sys.path.insert(0, p)
+            if cwd:
+                os.chdir(cwd)
+        finally:
+            await ctl.close()
 
     async def _watch_agent(self) -> None:
         """Exit when the node agent goes away — a worker without its node

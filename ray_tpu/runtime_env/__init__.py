@@ -1,0 +1,184 @@
+"""Per-task/actor runtime environments.
+
+Role-equivalent to the reference's runtime_env subsystem (ref:
+python/ray/_private/runtime_env/ — plugins working_dir.py, py_modules.py,
+packaging.py; applied by the raylet's worker pool keyed by env hash,
+worker_pool.h:216).  Redesigned host-native: packages are content-
+addressed zips in the controller KV (the cluster's metadata plane), so a
+TPU-pod worker fetches them over the same control connection it already
+has — no external storage, no per-node agent daemon.
+
+Supported fields:
+- ``env_vars``:   dict of environment variables set in the worker process
+                  before any user code runs.
+- ``working_dir``: local directory, packaged at first use and materialized
+                  as the worker's cwd (also on sys.path, matching the
+                  reference).
+- ``py_modules``: list of local package directories, each importable in
+                  the worker.
+
+Workers are cached per environment hash: tasks with the same runtime env
+reuse warm workers; a different env gets a fresh process (ref:
+worker_pool.h PopWorker runtime_env_hash matching).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+_PKG_PREFIX = "runtime_env/pkg/"
+_MAX_PKG_BYTES = 256 * 1024 * 1024
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def normalize(runtime_env: Optional[Dict[str, Any]]
+              ) -> Optional[Dict[str, Any]]:
+    """Validate + canonicalize a user-supplied runtime_env dict."""
+    if not runtime_env:
+        return None
+    allowed = {"env_vars", "working_dir", "py_modules"}
+    unknown = set(runtime_env) - allowed
+    if unknown:
+        raise ValueError(
+            f"Unsupported runtime_env keys {sorted(unknown)}; "
+            f"supported: {sorted(allowed)}")
+    out: Dict[str, Any] = {}
+    env_vars = runtime_env.get("env_vars") or {}
+    if env_vars:
+        if not all(isinstance(k, str) and isinstance(v, str)
+                   for k, v in env_vars.items()):
+            raise TypeError("runtime_env['env_vars'] must be Dict[str, str]")
+        out["env_vars"] = dict(sorted(env_vars.items()))
+    wd = runtime_env.get("working_dir")
+    if wd:
+        wd = os.path.abspath(os.path.expanduser(wd))
+        if not os.path.isdir(wd):
+            raise ValueError(f"working_dir {wd!r} is not a directory")
+        out["working_dir"] = wd
+    mods = runtime_env.get("py_modules") or []
+    if mods:
+        norm = []
+        for m in mods:
+            m = os.path.abspath(os.path.expanduser(m))
+            if not os.path.isdir(m):
+                raise ValueError(f"py_modules entry {m!r} is not a "
+                                 f"directory")
+            norm.append(m)
+        out["py_modules"] = norm
+    return out or None
+
+
+def _zip_dir(root: str) -> bytes:
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d not in _EXCLUDE_DIRS]
+            for fn in sorted(filenames):
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, root)
+                try:
+                    total += os.path.getsize(full)
+                except OSError:
+                    continue
+                if total > _MAX_PKG_BYTES:
+                    raise ValueError(
+                        f"runtime_env package {root!r} exceeds "
+                        f"{_MAX_PKG_BYTES >> 20} MiB")
+                zf.write(full, rel)
+    return buf.getvalue()
+
+
+def package(env: Dict[str, Any]
+            ) -> Tuple[Dict[str, Any], Dict[str, bytes]]:
+    """Driver side: build the wire spec + content-addressed blobs.
+
+    Pure (no IO beyond reading the dirs): returns (spec, {kv_key: zip
+    bytes}).  The caller uploads any blob whose key is not yet in the
+    controller KV; the spec (hashes + env_vars only) travels in the
+    TaskSpec.
+    """
+    blobs: Dict[str, bytes] = {}
+
+    def pack(path: str) -> str:
+        data = _zip_dir(path)
+        digest = hashlib.sha256(data).hexdigest()[:32]
+        blobs[_PKG_PREFIX + digest] = data
+        return digest
+
+    spec: Dict[str, Any] = {}
+    if env.get("env_vars"):
+        spec["env_vars"] = env["env_vars"]
+    if env.get("working_dir"):
+        spec["working_dir_pkg"] = pack(env["working_dir"])
+    if env.get("py_modules"):
+        spec["py_modules_pkgs"] = [
+            {"name": os.path.basename(m.rstrip(os.sep)),
+             "pkg": pack(m)} for m in env["py_modules"]]
+    spec["hash"] = env_hash(spec)
+    return spec, blobs
+
+
+def env_hash(spec: Optional[Dict[str, Any]]) -> str:
+    """Stable identity of a packaged spec — the worker-pool cache key."""
+    if not spec:
+        return ""
+    canon = {k: v for k, v in sorted(spec.items()) if k != "hash"}
+    return hashlib.sha256(
+        json.dumps(canon, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def materialize(spec: Dict[str, Any], kv_get, root: str
+                ) -> Tuple[Optional[str], List[str]]:
+    """Worker side: download + extract packages under ``root``.
+
+    Returns (cwd or None, sys.path additions).  Extraction is
+    idempotent + concurrency-safe: extract to a pid-suffixed temp dir,
+    then atomically rename into the content-addressed location.
+    """
+
+    def extract(digest: str) -> str:
+        dest = os.path.join(root, digest)
+        if os.path.isdir(dest):
+            return dest
+        data = kv_get(_PKG_PREFIX + digest)
+        if data is None:
+            raise RuntimeError(
+                f"runtime_env package {digest} missing from cluster KV")
+        tmp = f"{dest}.tmp.{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(data)) as zf:
+            zf.extractall(tmp)
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)  # raced; loser cleans up
+        return dest
+
+    cwd = None
+    paths: List[str] = []
+    if spec.get("working_dir_pkg"):
+        cwd = extract(spec["working_dir_pkg"])
+        paths.append(cwd)
+    for entry in spec.get("py_modules_pkgs", []):
+        # Each module dir X becomes importable as "X": extract the
+        # package and put its PARENT on sys.path via a named alias dir.
+        base = extract(entry["pkg"])
+        alias_root = os.path.join(root, f"mod-{entry['pkg']}")
+        alias = os.path.join(alias_root, entry["name"])
+        if not os.path.isdir(alias):
+            os.makedirs(alias_root, exist_ok=True)
+            try:
+                os.symlink(base, alias)
+            except OSError:
+                pass
+        paths.append(alias_root)
+    return cwd, paths

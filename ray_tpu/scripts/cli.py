@@ -43,13 +43,19 @@ def _record(config, session: str, *, address: str,
             pids: List[int], head: bool) -> None:
     path = _state_path(config, session)
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    state = {"session": session, "address": address, "head": head,
-             "pids": []}
+    old_pids: List[int] = []
     if os.path.exists(path):
-        with open(path) as f:
-            state = json.load(f)
-    state["pids"].extend(pids)
-    state["head"] = state.get("head", False) or head
+        try:
+            with open(path) as f:
+                prev = json.load(f)
+            old_pids = prev.get("pids", [])
+            head = head or prev.get("head", False)
+        except (json.JSONDecodeError, OSError):
+            pass
+    # Fresh address/session always win — a stale file from a dead
+    # cluster must not shadow the one just started.
+    state = {"session": session, "address": address, "head": head,
+             "pids": old_pids + pids}
     with open(path, "w") as f:
         json.dump(state, f)
     tmp = _latest_path(config) + ".tmp"

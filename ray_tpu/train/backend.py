@@ -115,12 +115,10 @@ class TorchBackend(Backend):
             s.close()
             return f"{get_node_ip_address()}:{port}"
 
-        from ..core import serialization
-
-        pick = serialization.dumps_code(_pick_master)
-        w0 = worker_group.workers[0]
-        master = ray_tpu.get(w0.actor.run.remote(pick, (), {}),
-                             timeout=60)
+        master = ray_tpu.get(
+            worker_group.execute_async_single(worker_group.workers[0],
+                                              _pick_master),
+            timeout=60)
 
         def _init(rank: int, world: int, addr: str):
             import os

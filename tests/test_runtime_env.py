@@ -1,0 +1,107 @@
+"""Runtime environments: env_vars at spawn, worker-pool caching by env
+hash, working_dir / py_modules materialization from the cluster KV.
+
+Ref: python/ray/_private/runtime_env/ + worker_pool.h:216 (PopWorker
+keyed by runtime-env hash) — VERDICT round-1 item 10.
+"""
+
+import os
+import sys
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster_rt():
+    rt = ray_tpu.init(mode="cluster", num_cpus=1)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_env_vars_and_worker_caching(cluster_rt):
+    @ray_tpu.remote
+    def probe():
+        return os.environ.get("RT_TEST_FLAVOR"), os.getpid()
+
+    # Default env: no var.
+    flavor, base_pid = ray_tpu.get(probe.remote(), timeout=60)
+    assert flavor is None
+
+    env_a = {"env_vars": {"RT_TEST_FLAVOR": "a"}}
+    fa = probe.options(runtime_env=env_a)
+    flavor, pid_a1 = ray_tpu.get(fa.remote(), timeout=60)
+    assert flavor == "a"
+    assert pid_a1 != base_pid  # fresh worker for the new env
+
+    # Same env again: the warm worker is reused.
+    flavor, pid_a2 = ray_tpu.get(fa.remote(), timeout=60)
+    assert (flavor, pid_a2) == ("a", pid_a1)
+
+    # Different env: different worker.
+    fb = probe.options(runtime_env={"env_vars": {"RT_TEST_FLAVOR": "b"}})
+    flavor, pid_b = ray_tpu.get(fb.remote(), timeout=60)
+    assert flavor == "b"
+    assert pid_b not in (pid_a1, base_pid)
+
+
+def test_working_dir_and_py_modules(cluster_rt, tmp_path):
+    wd = tmp_path / "proj"
+    wd.mkdir()
+    (wd / "data.txt").write_text("hello-from-working-dir")
+    (wd / "helper.py").write_text("VALUE = 41\n")
+    mod = tmp_path / "extmod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("def answer():\n    return 42\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(wd),
+                                 "py_modules": [str(mod)]})
+    def use_env():
+        import extmod
+        import helper
+
+        with open("data.txt") as f:
+            data = f.read()
+        return data, helper.VALUE, extmod.answer()
+
+    data, v, a = ray_tpu.get(use_env.remote(), timeout=90)
+    assert data == "hello-from-working-dir"
+    assert v == 41
+    assert a == 42
+
+
+def test_actor_runtime_env(cluster_rt):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_ACTOR_FLAVOR": "x"}})
+    class Holder:
+        def flavor(self):
+            return os.environ.get("RT_ACTOR_FLAVOR")
+
+    h = Holder.remote()
+    assert ray_tpu.get(h.flavor.remote(), timeout=60) == "x"
+    ray_tpu.kill(h)
+
+
+def test_bad_runtime_env_raises_at_options():
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError):
+        f.options(runtime_env={"working_dir": "/nonexistent-dir-xyz"})
+    with pytest.raises(ValueError):
+        f.options(runtime_env={"pip": ["requests"]})
+
+
+def test_runtime_env_validation():
+    from ray_tpu import runtime_env as renv
+
+    with pytest.raises(ValueError):
+        renv.normalize({"pip": ["requests"]})
+    with pytest.raises(TypeError):
+        renv.normalize({"env_vars": {"A": 1}})
+    assert renv.normalize(None) is None
+    assert renv.normalize({}) is None
+    spec, blobs = renv.package(
+        renv.normalize({"env_vars": {"A": "1"}}) or {})
+    assert spec["env_vars"] == {"A": "1"} and not blobs
