@@ -513,27 +513,43 @@ class ClusterRuntime(BaseRuntime):
         if cache is None:
             cache = self._renv_cache = {}
         key = _json.dumps(raw, sort_keys=True)
-        if key in cache:
-            return cache[key]
+        fut = cache.get(key)
+        if fut is not None:
+            # Concurrent submitters share one packaging pass; a cached
+            # failure re-raises for every awaiter.
+            return await fut
+        loop = asyncio.get_event_loop()
+        fut = cache[key] = loop.create_future()
         from .. import runtime_env as renv
 
         try:
-            wire, blobs = renv.package(renv.normalize(raw) or {})
+            # Zip + hash can be hundreds of MiB — keep it off the io
+            # loop, which also carries every other RPC of this driver.
+            wire, blobs = await loop.run_in_executor(
+                None, lambda: renv.package(renv.normalize(raw) or {}))
+            if len(wire) <= 1:  # only the hash of an empty env
+                wire = None
+            else:
+                for kv_key, data in blobs.items():
+                    existing = await self._ctl.call("kv_keys",
+                                                    {"prefix": kv_key})
+                    if not existing:
+                        await self._ctl.call(
+                            "kv_put", {"key": kv_key, "value": data})
         except (ValueError, TypeError) as e:
             # Surface as a task failure (the submit loop's except clauses
             # resolve the returns); never let it escape the io-loop task,
             # which would leave the ObjectRef unresolved forever.
-            raise RemoteCallError(e) from None
-        if len(wire) <= 1:  # only the hash of an empty env
-            cache[key] = None
-            return None
-        for kv_key, data in blobs.items():
-            existing = await self._ctl.call("kv_keys",
-                                            {"prefix": kv_key})
-            if not existing:
-                await self._ctl.call("kv_put",
-                                     {"key": kv_key, "value": data})
-        cache[key] = wire
+            err = RemoteCallError(e)
+            fut.set_exception(err)
+            fut.exception()  # consumed; avoid 'never retrieved' warnings
+            raise err from None
+        except Exception as e:
+            cache.pop(key, None)  # transient (e.g. RPC): allow retry
+            fut.set_exception(e)
+            fut.exception()
+            raise
+        fut.set_result(wire)
         return wire
 
     async def _lease_and_push(self, spec: TaskSpec,

@@ -85,6 +85,16 @@ class Controller:
         self.event_waiters: List[asyncio.Event] = []
         self.jobs: Dict[int, Dict] = {}
         self.job_counter = 1
+        # Task-event sink (ref: gcs_task_manager.h:86 GcsTaskManager):
+        # bounded per-task records for the state API + Chrome-trace
+        # timeline export; oldest finished records are dropped first.
+        from collections import OrderedDict
+
+        self.task_records: "OrderedDict[str, Dict]" = OrderedDict()
+        self.task_events_dropped = 0
+        # Cluster metrics: latest snapshot per reporting source (ref:
+        # metrics agent / opencensus exporter, metric_defs.cc).
+        self.metrics_sources: Dict[str, Any] = {}
         self._agent_clients: Dict[NodeID, RpcClient] = {}
         self._placement = None  # PlacementGroupManager, attached in setup
         self._shutdown = asyncio.Event()
@@ -100,6 +110,8 @@ class Controller:
             "create_placement_group", "remove_placement_group",
             "get_placement_group", "list_placement_groups",
             "list_actors", "cluster_shutdown", "ping", "drain_node",
+            "task_events", "list_tasks", "get_task", "list_objects",
+            "list_jobs", "report_metrics", "metrics_text",
         ]:
             self.server.register(name, getattr(self, name))
 
@@ -370,11 +382,36 @@ class Controller:
             return {"ok": False, "exists": True}
         self.kv[p["key"]] = p["value"]
         self.kv_list_counts.pop(p["key"], None)  # no longer a list value
+        if p["key"].startswith("runtime_env/pkg/"):
+            self._touch_pkg(p["key"], len(p["value"]))
         self._publish("kv", {"key": p["key"]})
         return {"ok": True}
 
+    def _touch_pkg(self, key: str, size: int) -> None:
+        """LRU cap on runtime-env package blobs: the KV is controller
+        memory, and every edited working_dir is a new content digest —
+        without eviction a long-lived cluster grows without bound (ref:
+        runtime_env URI reference counting / cache GC in
+        _private/runtime_env/packaging.py)."""
+        from collections import OrderedDict
+
+        lru = getattr(self, "_pkg_lru", None)
+        if lru is None:
+            lru = self._pkg_lru = OrderedDict()
+        lru.pop(key, None)
+        lru[key] = size
+        cap = self.config.runtime_env_cache_bytes
+        while sum(lru.values()) > cap and len(lru) > 1:
+            victim, _ = lru.popitem(last=False)
+            self.kv.pop(victim, None)
+            logger.info("evicted runtime_env package %s (cache > %d)",
+                        victim, cap)
+
     async def kv_get(self, p):
-        return self.kv.get(p["key"])
+        val = self.kv.get(p["key"])
+        if val is not None and p["key"].startswith("runtime_env/pkg/"):
+            self._touch_pkg(p["key"], len(val))
+        return val
 
     async def kv_del(self, p):
         self.kv.pop(p["key"], None)
@@ -564,6 +601,116 @@ class Controller:
                 self.event_waiters.remove(ev)
 
     # ------------------------------------------------------------------ jobs
+    # ----------------------------------------------------- task events
+    async def task_events(self, p):
+        """Batched task state transitions from workers (ref:
+        task_event_buffer.h:222 flush -> gcs_task_manager.h:86)."""
+        cap = max(self.config.task_event_buffer_size, 16)
+        for ev in p["events"]:
+            tid = ev["task_id"]
+            rec = self.task_records.get(tid)
+            if rec is None:
+                if len(self.task_records) >= cap:
+                    # Evict the oldest finished record first.
+                    for k, r in self.task_records.items():
+                        if r.get("state") in ("FINISHED", "FAILED"):
+                            del self.task_records[k]
+                            break
+                    else:
+                        self.task_records.popitem(last=False)
+                    self.task_events_dropped += 1
+                rec = self.task_records[tid] = {
+                    "task_id": tid, "times": {}}
+            rec.update({k: v for k, v in ev.items()
+                        if k not in ("task_id", "state", "ts")})
+            state = ev.get("state")
+            if state:
+                rec["state"] = state
+                rec["times"][state] = ev["ts"]
+        return {"ok": True}
+
+    async def list_tasks(self, p):
+        out = []
+        limit = p.get("limit", 1000)
+        flt_state = p.get("state")
+        flt_name = p.get("name")
+        for rec in reversed(self.task_records.values()):
+            if flt_state and rec.get("state") != flt_state:
+                continue
+            if flt_name and rec.get("name") != flt_name:
+                continue
+            out.append(rec)
+            if len(out) >= limit:
+                break
+        return {"tasks": out, "dropped": self.task_events_dropped,
+                "total": len(self.task_records)}
+
+    async def get_task(self, p):
+        return self.task_records.get(p["task_id"])
+
+    async def list_objects(self, p):
+        out = []
+        limit = p.get("limit", 1000)
+        for oid, info in self.object_dir.items():
+            out.append({
+                "object_id": oid.hex() if hasattr(oid, "hex") else str(oid),
+                "size": info.get("size", 0),
+                "nodes": [n.hex() if hasattr(n, "hex") else str(n)
+                          for n in info.get("nodes", ())],
+            })
+            if len(out) >= limit:
+                break
+        return {"objects": out, "total": len(self.object_dir)}
+
+    async def list_jobs(self, p):
+        return {"jobs": [dict(j, job_id=jid)
+                         for jid, j in self.jobs.items()]}
+
+    # --------------------------------------------------------- metrics
+    async def report_metrics(self, p):
+        self.metrics_sources[p["source"]] = {
+            "snapshot": p["snapshot"], "ts": time.time()}
+        return {"ok": True}
+
+    async def metrics_text(self, _p):
+        from ray_tpu.util.metrics import render_prometheus
+
+        # Drop sources that stopped reporting (dead workers/nodes) — a
+        # gauge from a dead process must not render as current, and the
+        # map must not grow with worker churn.
+        horizon = max(self.config.metrics_report_period_s * 6, 30.0)
+        now = time.time()
+        for src in [s for s, v in self.metrics_sources.items()
+                    if now - v["ts"] > horizon]:
+            del self.metrics_sources[src]
+        sources = {s: v["snapshot"]
+                   for s, v in self.metrics_sources.items()}
+        # Controller-internal gauges, rendered with the same pipeline.
+        alive = sum(1 for n in self.nodes.values() if n.alive)
+        internal = [
+            {"name": "rt_nodes_alive", "kind": "gauge",
+             "description": "Alive node agents.",
+             "series": [{"tags": {}, "value": alive}]},
+            {"name": "rt_nodes_total", "kind": "gauge",
+             "description": "Ever-registered node agents.",
+             "series": [{"tags": {}, "value": len(self.nodes)}]},
+            {"name": "rt_actors", "kind": "gauge",
+             "description": "Actors by state.",
+             "series": [{"tags": {"state": s},
+                         "value": sum(1 for a in self.actors.values()
+                                      if a.state == s)}
+                        for s in ("ALIVE", "PENDING", "RESTARTING",
+                                  "DEAD")]},
+            {"name": "rt_tasks_recorded", "kind": "gauge",
+             "description": "Task records retained.",
+             "series": [{"tags": {}, "value": len(self.task_records)}]},
+            {"name": "rt_objects_tracked", "kind": "gauge",
+             "description": "Objects in the cluster directory.",
+             "series": [{"tags": {}, "value": len(self.object_dir)}]},
+        ]
+        sources["controller"] = internal
+        return {"text": render_prometheus(sources)}
+
     async def register_job(self, p):
         jid = self.job_counter
         self.job_counter += 1

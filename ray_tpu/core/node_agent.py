@@ -124,6 +124,7 @@ class NodeAgent:
             "request_lease", "return_lease", "lease_status",
             "cancel_lease_request",
             "register_worker", "worker_heartbeat",
+            "report_task_events", "report_metrics",
             "task_blocked", "task_unblocked",
             "register_object", "pull_object", "fetch_raw", "delete_object",
             "object_exists", "store_stats",
@@ -153,6 +154,7 @@ class NodeAgent:
     async def _heartbeat_loop(self) -> None:
         period = self.config.raylet_heartbeat_period_ms / 1000.0
         misses = 0
+        last_metrics = 0.0
         while not self._shutdown.is_set():
             try:
                 r = await self._ctl.call("heartbeat", {
@@ -160,6 +162,13 @@ class NodeAgent:
                     "available": {k: max(v, 0.0) for k, v in
                                   self.available.amounts.items()},
                     "total": dict(self.total.amounts)})
+                now = time.time()
+                if now - last_metrics >= \
+                        self.config.metrics_report_period_s:
+                    last_metrics = now
+                    await self._ctl.call("report_metrics", {
+                        "source": f"node-{self.node_id.hex()[:8]}",
+                        "snapshot": self._node_metrics_snapshot()})
                 if r.get("reregister"):
                     await self._ctl.call("register_node", {
                         "node_id": self.node_id,
@@ -217,17 +226,19 @@ class NodeAgent:
     def _spawn_worker(self, runtime_env: Optional[Dict] = None) -> None:
         env = dict(os.environ)
         env.update(self.config.env_overrides())
+        env_hash = ""
+        if runtime_env:
+            env_hash = runtime_env.get("hash", "")
+            env.update(runtime_env.get("env_vars", {}))
+            env["RT_RUNTIME_ENV"] = json.dumps(runtime_env)
+        # Control-plane vars LAST: user env_vars must never override the
+        # addresses the worker needs to register at all.
         env.update({
             "RT_SESSION_NAME": self.session,
             "RT_CONTROLLER_ADDR": self.controller_addr,
             "RT_AGENT_ADDR": self.server.address,
             "RT_NODE_ID": self.node_id.hex(),
         })
-        env_hash = ""
-        if runtime_env:
-            env_hash = runtime_env.get("hash", "")
-            env.update(runtime_env.get("env_vars", {}))
-            env["RT_RUNTIME_ENV"] = json.dumps(runtime_env)
         log_dir = os.path.join(self.config.session_dir_root, self.session,
                                "logs")
         os.makedirs(log_dir, exist_ok=True)
@@ -269,6 +280,51 @@ class NodeAgent:
 
     async def worker_heartbeat(self, p):
         return {"ok": True}
+
+    async def report_task_events(self, p):
+        """Relay worker task events to the controller sink (workers have
+        no persistent controller connection; the agent does)."""
+        try:
+            await self._ctl.call("task_events", {"events": p["events"]})
+        except RpcError:
+            pass
+        return {"ok": True}
+
+    async def report_metrics(self, p):
+        try:
+            await self._ctl.call("report_metrics", p)
+        except RpcError:
+            pass
+        return {"ok": True}
+
+    def _node_metrics_snapshot(self) -> List[Dict]:
+        n_obj, used, cap = self.directory.stats()
+        states: Dict[str, int] = {}
+        for w in self.workers.values():
+            states[w.state] = states.get(w.state, 0) + 1
+        return [
+            {"name": "rt_node_workers", "kind": "gauge",
+             "description": "Worker processes by state.",
+             "series": [{"tags": {"state": s}, "value": v}
+                        for s, v in states.items()]},
+            {"name": "rt_node_leases_active", "kind": "gauge",
+             "description": "Granted worker leases.",
+             "series": [{"tags": {}, "value": len(self.leases)}]},
+            {"name": "rt_node_leases_pending", "kind": "gauge",
+             "description": "Queued lease requests.",
+             "series": [{"tags": {}, "value": len(self.pending)}]},
+            {"name": "rt_node_object_store_bytes", "kind": "gauge",
+             "description": "Local shared-memory store usage.",
+             "series": [{"tags": {"kind": "used"}, "value": used},
+                        {"tags": {"kind": "capacity"}, "value": cap}]},
+            {"name": "rt_node_objects", "kind": "gauge",
+             "description": "Objects in the local store.",
+             "series": [{"tags": {}, "value": n_obj}]},
+            {"name": "rt_node_resources_available", "kind": "gauge",
+             "description": "Schedulable resources available.",
+             "series": [{"tags": {"resource": k}, "value": v}
+                        for k, v in self.available.amounts.items()]},
+        ]
 
     def _max_workers(self) -> int:
         cap = self.config.worker_pool_max_workers

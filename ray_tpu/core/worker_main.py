@@ -23,6 +23,7 @@ import logging
 import os
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
@@ -35,7 +36,7 @@ from .cluster_runtime import ClusterRuntime
 from .config import RuntimeConfig
 from .errors import ActorError, TaskCancelledError, TaskError
 from .ids import ActorID, JobID, WorkerID
-from .rpc import RpcClient, RpcServer
+from .rpc import RpcClient, RpcError, RpcServer
 from .task import ArgKind, TaskResult, TaskSpec
 
 logger = logging.getLogger("ray_tpu.worker")
@@ -68,6 +69,11 @@ class Worker:
 
         self._cancelled_task_ids: "OrderedDict[Any, None]" = OrderedDict()
         self._current_sync_task: Optional[Tuple[Any, int]] = None
+        # Task-event buffer: state transitions recorded here (any
+        # thread), flushed in batches to the agent -> controller (ref:
+        # task_event_buffer.h:222 periodic flush to GcsTaskManager).
+        self._event_buf: List[Dict] = []
+        self._event_lock = threading.Lock()
         for name in ["push_task", "create_actor", "push_actor_task",
                      "cancel_task", "ping", "exit"]:
             self.server.register(name, getattr(self, name))
@@ -91,6 +97,44 @@ class Worker:
             "pid": os.getpid()})
         self._agent = agent
         asyncio.ensure_future(self._watch_agent())
+        asyncio.ensure_future(self._flush_loop())
+
+    def _emit_event(self, spec: TaskSpec, state: str, **extra) -> None:
+        ev = {"task_id": spec.task_id.hex(), "state": state,
+              "ts": time.time(), "name": spec.display_name(),
+              "kind": spec.kind.name, "node_id": self.node_id_hex,
+              "worker_pid": os.getpid()}
+        if spec.actor_id is not None:
+            ev["actor_id"] = spec.actor_id.hex()
+        ev.update(extra)
+        with self._event_lock:
+            self._event_buf.append(ev)
+
+    async def _flush_loop(self) -> None:
+        """Ship task events + metric snapshots on one cadence."""
+        period = max(self.config.metrics_report_period_s, 0.25)
+        last_metrics = 0.0
+        while True:
+            await asyncio.sleep(min(period, 1.0))
+            with self._event_lock:
+                batch, self._event_buf = self._event_buf, []
+            try:
+                if batch:
+                    await self._agent.call("report_task_events",
+                                           {"events": batch})
+                now = time.time()
+                if now - last_metrics >= period:
+                    last_metrics = now
+                    from ray_tpu.util.metrics import registry
+
+                    snap = registry().snapshot()
+                    if snap:
+                        await self._agent.call("report_metrics", {
+                            "source": f"worker-{self.node_id_hex[:8]}"
+                                      f"-{os.getpid()}",
+                            "snapshot": snap})
+            except RpcError:
+                pass  # agent gone; _watch_agent will exit us
 
     async def _setup_runtime_env(self) -> None:
         """Materialize working_dir / py_modules before any user code can
@@ -260,12 +304,16 @@ class Worker:
         ctypes.pythonapi.PyThreadState_SetAsyncExc(
             ctypes.c_ulong(threading.get_ident()), None)
         self._current_sync_task = (spec.task_id, threading.get_ident())
+        self._emit_event(spec, "RUNNING")
         try:
             pos, kwargs = self._resolve_args(spec)
             result = fn(*pos, **kwargs)
-            return self._package_returns(spec, result)
+            out = self._package_returns(spec, result)
+            self._emit_event(spec, "FINISHED")
+            return out
         except BaseException as e:  # noqa: BLE001 — shipped to owner
             kind = ActorError if spec.kind.name == "ACTOR_TASK" else TaskError
+            self._emit_event(spec, "FAILED", error=repr(e))
             return TaskResult(task_id=spec.task_id, ok=False,
                               error=kind.from_exception(e))
         finally:
@@ -365,15 +413,19 @@ class Worker:
         # concurrent async methods would cross-contaminate it (object
         # IDs stay unique regardless: the put counter is process-global).
         loop = asyncio.get_event_loop()
+        self._emit_event(spec, "RUNNING")
         try:
             # Arg resolution may block on remote objects; keep it off the
             # event loop so other handlers stay live.
             pos, kwargs = await loop.run_in_executor(
                 self._task_executor, self._resolve_args, spec)
             result = await method(*pos, **kwargs)
-            return await loop.run_in_executor(
+            out = await loop.run_in_executor(
                 self._task_executor, self._package_returns, spec, result)
+            self._emit_event(spec, "FINISHED")
+            return out
         except BaseException as e:  # noqa: BLE001
+            self._emit_event(spec, "FAILED", error=repr(e))
             return TaskResult(task_id=spec.task_id, ok=False,
                               error=ActorError.from_exception(e))
 

@@ -53,6 +53,11 @@ def normalize(runtime_env: Optional[Dict[str, Any]]
         if not all(isinstance(k, str) and isinstance(v, str)
                    for k, v in env_vars.items()):
             raise TypeError("runtime_env['env_vars'] must be Dict[str, str]")
+        reserved = [k for k in env_vars if k.startswith("RT_")]
+        if reserved:
+            raise ValueError(
+                f"runtime_env env_vars {reserved} use the reserved RT_ "
+                f"prefix (framework control variables)")
         out["env_vars"] = dict(sorted(env_vars.items()))
     wd = runtime_env.get("working_dir")
     if wd:

@@ -232,6 +232,65 @@ def cmd_stop(args) -> int:
     return 0
 
 
+def cmd_list(args) -> int:
+    from ray_tpu.util import state as state_api
+
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    entity = args.entity
+    fns = {
+        "tasks": lambda: state_api.list_tasks(
+            state=args.state or None, limit=args.limit, address=address),
+        "actors": lambda: state_api.list_actors(address=address),
+        "nodes": lambda: state_api.list_nodes(address=address),
+        "objects": lambda: state_api.list_objects(
+            limit=args.limit, address=address),
+        "jobs": lambda: state_api.list_jobs(address=address),
+        "placement-groups": lambda: state_api.list_placement_groups(
+            address=address),
+    }
+    rows = fns[entity]()
+    if args.format == "json":
+        print(json.dumps(rows, indent=2, default=repr))
+        return 0
+    if not rows:
+        print(f"(no {entity})")
+        return 0
+    cols = sorted({k for r in rows for k in r
+                   if not isinstance(r[k], (dict, list))})
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in cols}
+    print("  ".join(c.ljust(widths[c]) for c in cols))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    from ray_tpu.util import state as state_api
+
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    trace = state_api.timeline(args.out, address=address)
+    print(f"Wrote {len(trace)} trace events to {args.out}")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    from ray_tpu.util import state as state_api
+
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    sys.stdout.write(state_api.metrics_text(address=address))
+    return 0
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="rt", description="ray_tpu cluster CLI")
@@ -266,6 +325,29 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--local-only", action="store_true",
                     help="kill local processes without cluster shutdown")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("list", help="state API listings")
+    sp.add_argument("entity", choices=["tasks", "actors", "nodes",
+                                       "objects", "jobs",
+                                       "placement-groups"])
+    sp.add_argument("--address", default="")
+    sp.add_argument("--state", default="",
+                    help="tasks only: RUNNING|FINISHED|FAILED")
+    sp.add_argument("--limit", type=int, default=100)
+    sp.add_argument("--format", choices=["table", "json"],
+                    default="table")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("timeline",
+                        help="export Chrome-trace of task events")
+    sp.add_argument("--out", default="timeline.json")
+    sp.add_argument("--address", default="")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("metrics",
+                        help="print Prometheus metrics exposition")
+    sp.add_argument("--address", default="")
+    sp.set_defaults(fn=cmd_metrics)
     return p
 
 

@@ -5,6 +5,7 @@ Role-equivalent to the reference's python/ray/util/ package surface.
 
 from .placement_group import (PlacementGroup, get_placement_group,  # noqa
                               placement_group, remove_placement_group)
+from .metrics import Counter, Gauge, Histogram  # noqa
 from .scheduling_strategies import (NodeAffinitySchedulingStrategy,  # noqa
                                     NodeLabelSchedulingStrategy,
                                     PlacementGroupSchedulingStrategy)

@@ -1,0 +1,143 @@
+"""Cluster state API: list/get tasks, actors, objects, nodes, jobs,
+placement groups — plus Chrome-trace timeline export.
+
+Role-equivalent to the reference's ray.util.state (ref:
+python/ray/util/state/api.py backed by GCS task events,
+gcs_task_manager.h:86) and ray.timeline (ref: _private/state.py:960).
+Works from a connected driver (uses the runtime's controller channel) or
+standalone by address (``rt list ...`` CLI path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, List, Optional
+
+
+def _call(method: str, payload: Optional[Dict] = None,
+          address: Optional[str] = None) -> Any:
+    from ..core import runtime as runtime_mod
+
+    rt = runtime_mod.get_runtime_quiet()
+    if rt is not None and hasattr(rt, "controller_call") and address is None:
+        return rt.controller_call(method, payload or {})
+    from ..core.rpc import RpcClient
+    from ..scripts.cli import resolve_address
+
+    addr = resolve_address(address=address)
+    if addr is None:
+        raise ConnectionError(
+            "No cluster: call ray_tpu.init() first or pass address=.")
+
+    async def _go():
+        cli = RpcClient(addr, connect_timeout=10.0)
+        try:
+            return await cli.call(method, payload or {})
+        finally:
+            await cli.close()
+
+    return asyncio.run(_go())
+
+
+def list_tasks(*, state: Optional[str] = None, name: Optional[str] = None,
+               limit: int = 1000,
+               address: Optional[str] = None) -> List[Dict]:
+    """Task records from the controller sink.  ``state`` filters on
+    RUNNING / FINISHED / FAILED."""
+    r = _call("list_tasks", {"state": state, "name": name, "limit": limit},
+              address)
+    return r["tasks"]
+
+
+def get_task(task_id: str, *, address: Optional[str] = None
+             ) -> Optional[Dict]:
+    return _call("get_task", {"task_id": task_id}, address)
+
+
+def list_actors(*, address: Optional[str] = None) -> List[Dict]:
+    actors = _call("list_actors", {}, address)
+    out = []
+    for a in actors:
+        d = dict(a)
+        for k in ("actor_id", "node_id"):
+            v = d.get(k)
+            if hasattr(v, "hex"):
+                d[k] = v.hex()
+        out.append(d)
+    return out
+
+
+def list_nodes(*, address: Optional[str] = None) -> List[Dict]:
+    nodes = _call("list_nodes", {}, address)
+    out = []
+    for n in nodes:
+        d = dict(n)
+        v = d.get("node_id")
+        if hasattr(v, "hex"):
+            d["node_id"] = v.hex()
+        out.append(d)
+    return out
+
+
+def list_objects(*, limit: int = 1000,
+                 address: Optional[str] = None) -> List[Dict]:
+    return _call("list_objects", {"limit": limit}, address)["objects"]
+
+
+def list_jobs(*, address: Optional[str] = None) -> List[Dict]:
+    return _call("list_jobs", {}, address)["jobs"]
+
+
+def list_placement_groups(*, address: Optional[str] = None) -> List[Dict]:
+    pgs = _call("list_placement_groups", {}, address)
+    return [dict(p) for p in pgs] if isinstance(pgs, list) else pgs
+
+
+def metrics_text(*, address: Optional[str] = None) -> str:
+    """Cluster-wide Prometheus exposition text."""
+    return _call("metrics_text", {}, address)["text"]
+
+
+def timeline(filename: Optional[str] = None, *,
+             address: Optional[str] = None) -> Any:
+    """Chrome-trace (chrome://tracing / perfetto) export of task events
+    (ref: ray.timeline, _private/state.py:960).
+
+    Returns the trace list; writes JSON to ``filename`` if given.
+    """
+    tasks = list_tasks(limit=100000, address=address)
+    trace: List[Dict] = []
+    for rec in tasks:
+        times = rec.get("times", {})
+        start = times.get("RUNNING")
+        end = times.get("FINISHED") or times.get("FAILED")
+        row = {"pid": f"node:{rec.get('node_id', '?')[:8]}",
+               "tid": f"worker:{rec.get('worker_pid', '?')}"}
+        if start is None:
+            continue
+        if end is None:
+            trace.append({"ph": "B", "name": rec.get("name", "?"),
+                          "ts": start * 1e6, "cat": "task",
+                          "args": {"task_id": rec["task_id"],
+                                   "state": rec.get("state")}, **row})
+        else:
+            trace.append({
+                "ph": "X", "name": rec.get("name", "?"),
+                "ts": start * 1e6, "dur": max(end - start, 0) * 1e6,
+                "cat": "task",
+                "args": {"task_id": rec["task_id"],
+                         "state": rec.get("state"),
+                         "error": rec.get("error")}, **row})
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+def summarize_tasks(*, address: Optional[str] = None) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for rec in list_tasks(limit=100000, address=address):
+        s = rec.get("state", "?")
+        counts[s] = counts.get(s, 0) + 1
+    return counts

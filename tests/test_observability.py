@@ -1,0 +1,181 @@
+"""Observability: task events -> state API, Chrome-trace timeline,
+metrics registry -> cluster Prometheus exposition.
+
+Ref: gcs_task_manager.h:86 (task event sink), util/state/api.py (state
+API), _private/state.py:960 (ray.timeline), ray.util.metrics +
+metric_defs.cc (metrics) — VERDICT round-1 item 9 / missing 4.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state as state_api
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def rt():
+    handle = ray_tpu.init(mode="cluster", num_cpus=2,
+                          config={"metrics_report_period_s": 0.5})
+    yield handle
+    ray_tpu.shutdown()
+
+
+def _wait(pred, timeout=30, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.25)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def test_task_events_and_state_api(rt):
+    @ray_tpu.remote
+    def ok_task():
+        time.sleep(0.05)
+        return 1
+
+    @ray_tpu.remote
+    def bad_task():
+        raise RuntimeError("observability-bang")
+
+    assert ray_tpu.get(ok_task.remote(), timeout=60) == 1
+    with pytest.raises(RuntimeError):
+        ray_tpu.get(bad_task.remote(), timeout=60)
+
+    tasks = _wait(
+        lambda: [t for t in state_api.list_tasks()
+                 if t.get("name") in ("ok_task", "bad_task")]
+        if len([t for t in state_api.list_tasks()
+                if t.get("name") in ("ok_task", "bad_task")]) >= 2
+        else None,
+        what="task events to arrive")
+    by_name = {t["name"]: t for t in tasks}
+    ok = by_name["ok_task"]
+    assert ok["state"] == "FINISHED"
+    assert ok["times"]["FINISHED"] >= ok["times"]["RUNNING"]
+    assert ok["worker_pid"] > 0 and len(ok["node_id"]) > 8
+    bad = by_name["bad_task"]
+    assert bad["state"] == "FAILED"
+    assert "observability-bang" in bad["error"]
+
+    # Filtering.
+    failed = state_api.list_tasks(state="FAILED")
+    assert all(t["state"] == "FAILED" for t in failed)
+    assert any(t["name"] == "bad_task" for t in failed)
+
+    # get_task round-trip + summary.
+    rec = state_api.get_task(ok["task_id"])
+    assert rec["name"] == "ok_task"
+    counts = state_api.summarize_tasks()
+    assert counts.get("FINISHED", 0) >= 1 and counts.get("FAILED", 0) >= 1
+
+
+def test_actor_task_events(rt):
+    @ray_tpu.remote
+    class Obs:
+        def work(self):
+            return "done"
+
+        async def awork(self):
+            return "adone"
+
+    a = Obs.remote()
+    assert ray_tpu.get(a.work.remote(), timeout=60) == "done"
+    assert ray_tpu.get(a.awork.remote(), timeout=60) == "adone"
+    recs = _wait(
+        lambda: [t for t in state_api.list_tasks()
+                 if t.get("kind") == "ACTOR_TASK"
+                 and t.get("name", "").startswith("Obs.")] or None,
+        what="actor task events")
+    names = {t["name"] for t in recs}
+    assert {"Obs.work", "Obs.awork"} <= names
+    assert all(t.get("actor_id") for t in recs)
+    ray_tpu.kill(a)
+
+
+def test_timeline_export(rt, tmp_path):
+    out = tmp_path / "trace.json"
+    trace = ray_tpu.timeline(str(out))
+    assert out.exists()
+    loaded = json.loads(out.read_text())
+    assert loaded and any(ev["ph"] == "X" for ev in loaded)
+    ev = next(e for e in loaded if e["ph"] == "X")
+    assert {"name", "ts", "dur", "pid", "tid"} <= set(ev)
+    assert trace == loaded
+
+
+def test_metrics_registry_and_exposition(rt):
+    from ray_tpu.util.metrics import (Counter, Gauge, Histogram,
+                                      render_prometheus, registry)
+
+    # Local registry semantics.
+    c = Counter("test_requests", "Requests.", tag_keys=("route",))
+    c.inc(2, tags={"route": "/a"})
+    c.inc(1, tags={"route": "/b"})
+    g = Gauge("test_temp", "Temp.")
+    g.set(3.5)
+    h = Histogram("test_lat", "Latency.", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = render_prometheus({"me": registry().snapshot()})
+    assert 'test_requests{route="/a",source="me"} 2.0' in text
+    assert "# TYPE test_lat histogram" in text
+    assert 'test_lat_count{source="me"} 3' in text
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        c.inc(1, tags={"bogus": "x"})
+
+    # Metrics emitted inside a worker surface in the cluster exposition.
+    @ray_tpu.remote
+    def work_with_metrics():
+        from ray_tpu.util.metrics import Counter
+
+        wc = Counter("test_worker_units", "Worker units.")
+        wc.inc(7)
+        return True
+
+    assert ray_tpu.get(work_with_metrics.remote(), timeout=60)
+    text = _wait(
+        lambda: (lambda t: t if "test_worker_units" in t else None)(
+            state_api.metrics_text()),
+        what="worker metrics to arrive")
+    assert "test_worker_units" in text
+    # Node-internal gauges present too.
+    assert "rt_node_workers" in text
+    assert 'rt_nodes_alive{source="controller"} 1' \
+        in text.replace(".0", "")
+
+
+def test_cli_list_and_metrics(rt):
+    addr = rt.controller_addr
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run_cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", *args],
+            capture_output=True, text=True, env=env, timeout=60)
+
+    out = run_cli("list", "nodes", "--address", addr)
+    assert out.returncode == 0, out.stderr
+    assert "node_id" in out.stdout
+
+    out = run_cli("list", "tasks", "--address", addr, "--format", "json")
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)
+
+    out = run_cli("metrics", "--address", addr)
+    assert out.returncode == 0, out.stderr
+    assert "rt_nodes_alive" in out.stdout

@@ -23,13 +23,13 @@ def cluster_rt():
 def test_env_vars_and_worker_caching(cluster_rt):
     @ray_tpu.remote
     def probe():
-        return os.environ.get("RT_TEST_FLAVOR"), os.getpid()
+        return os.environ.get("MY_TEST_FLAVOR"), os.getpid()
 
     # Default env: no var.
     flavor, base_pid = ray_tpu.get(probe.remote(), timeout=60)
     assert flavor is None
 
-    env_a = {"env_vars": {"RT_TEST_FLAVOR": "a"}}
+    env_a = {"env_vars": {"MY_TEST_FLAVOR": "a"}}
     fa = probe.options(runtime_env=env_a)
     flavor, pid_a1 = ray_tpu.get(fa.remote(), timeout=60)
     assert flavor == "a"
@@ -40,7 +40,7 @@ def test_env_vars_and_worker_caching(cluster_rt):
     assert (flavor, pid_a2) == ("a", pid_a1)
 
     # Different env: different worker.
-    fb = probe.options(runtime_env={"env_vars": {"RT_TEST_FLAVOR": "b"}})
+    fb = probe.options(runtime_env={"env_vars": {"MY_TEST_FLAVOR": "b"}})
     flavor, pid_b = ray_tpu.get(fb.remote(), timeout=60)
     assert flavor == "b"
     assert pid_b not in (pid_a1, base_pid)
@@ -72,10 +72,10 @@ def test_working_dir_and_py_modules(cluster_rt, tmp_path):
 
 
 def test_actor_runtime_env(cluster_rt):
-    @ray_tpu.remote(runtime_env={"env_vars": {"RT_ACTOR_FLAVOR": "x"}})
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_ACTOR_FLAVOR": "x"}})
     class Holder:
         def flavor(self):
-            return os.environ.get("RT_ACTOR_FLAVOR")
+            return os.environ.get("MY_ACTOR_FLAVOR")
 
     h = Holder.remote()
     assert ray_tpu.get(h.flavor.remote(), timeout=60) == "x"
