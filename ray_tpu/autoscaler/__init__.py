@@ -1,0 +1,12 @@
+"""Autoscaler: demand-driven node launch/terminate over a provider.
+
+Role-equivalent to the reference's autoscaler (ref:
+autoscaler/_private/autoscaler.py:171 StandardAutoscaler.update,
+resource_demand_scheduler.py bin-packing, fake_multi_node/ hermetic
+provider, gcp/tpu pod node types).
+"""
+
+from .autoscaler import NodeType, StandardAutoscaler  # noqa
+from .fake_provider import FakeNodeProvider  # noqa
+from .node_provider import NodeProvider  # noqa
+from .sdk import AutoscalingCluster  # noqa

@@ -16,6 +16,7 @@ python/ray/_private/node.py:1407 start_head_processes.
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 import subprocess
 import sys
@@ -34,6 +35,8 @@ from .object_ref import ObjectRef
 from .rpc import EventLoopThread, RemoteCallError, RpcClient, RpcError
 from .runtime import BaseRuntime
 from .task import ArgKind, TaskArg, TaskKind, TaskResult, TaskSpec
+
+logger = logging.getLogger("ray_tpu.runtime")
 
 _PUSH_RETRY_STATES = ("PENDING", "RESTARTING")
 
@@ -417,8 +420,10 @@ class ClusterRuntime(BaseRuntime):
         sub = _Submission(spec)
         for oid in oids:
             self._submissions[oid] = sub
-        self.io.call_soon(lambda: self.io.loop.create_task(
-            self._submit_normal(spec, sub, held)))
+        from .rpc import spawn_task
+
+        self.io.call_soon(lambda: spawn_task(
+            self._submit_normal(spec, sub, held), self.io.loop))
         return [ObjectRef(o) for o in oids]
 
     async def _submit_normal(self, spec: TaskSpec,
@@ -500,6 +505,29 @@ class ClusterRuntime(BaseRuntime):
             self._accept_returns(spec, result)
             return
 
+    async def _renv_blobs_present(self, key: str, wire) -> bool:
+        """Throttled check that the controller still holds this env's
+        package blobs — its KV applies an LRU cap (runtime_env_cache_
+        bytes), and a worker spawned against an evicted blob fails.
+        A positive result is cached for 30 s."""
+        checked = getattr(self, "_renv_checked", None)
+        if checked is None:
+            checked = self._renv_checked = {}
+        now = asyncio.get_event_loop().time()
+        if now - checked.get(key, -1e9) < 30.0:
+            return True
+        digests = ([wire["working_dir_pkg"]]
+                   if wire.get("working_dir_pkg") else []) + \
+            [e["pkg"] for e in wire.get("py_modules_pkgs", [])]
+        for digest in digests:
+            found = await self._ctl.call(
+                "kv_keys", {"prefix": f"runtime_env/pkg/{digest}"})
+            if not found:
+                checked.pop(key, None)
+                return False
+        checked[key] = now
+        return True
+
     async def _runtime_env_payload(self, spec: TaskSpec):
         """Package + upload the task's runtime_env once per driver; the
         lease payload carries only the small wire spec (ref: worker
@@ -517,7 +545,11 @@ class ClusterRuntime(BaseRuntime):
         if fut is not None:
             # Concurrent submitters share one packaging pass; a cached
             # failure re-raises for every awaiter.
-            return await fut
+            wire = await fut
+            if wire is None or await self._renv_blobs_present(key, wire):
+                return wire
+            cache.pop(key, None)  # blobs LRU-evicted: re-package below
+            fut = None
         loop = asyncio.get_event_loop()
         fut = cache[key] = loop.create_future()
         from .. import runtime_env as renv
@@ -583,7 +615,17 @@ class ClusterRuntime(BaseRuntime):
         while True:
             sub.agent_addr = agent_addr
             agent = await self._agent_for(agent_addr)
+            logger.debug("lease req %s -> %s (hops=%d)",
+                         spec.display_name(), agent_addr, hops)
             grant = await agent.call("request_lease", payload)
+            logger.debug("lease rsp %s <- %s: %s", spec.display_name(),
+                         agent_addr, grant and
+                         {k: grant[k] for k in ("ok", "retry_at", "error",
+                                                "lease_id")
+                          if k in grant})
+            if grant is None:  # defensive: agent bug, not retryable
+                raise RemoteCallError(RuntimeError(
+                    f"agent {agent_addr} returned an empty lease grant"))
             if grant.get("cancelled") or sub.cancelled:
                 if grant.get("ok"):
                     await agent.call("return_lease",

@@ -96,6 +96,9 @@ define_flag("metrics_report_period_s", float, 5.0,
 define_flag("task_event_buffer_size", int, 10000,
             "Max buffered per-task lifecycle events before drop-oldest.")
 define_flag("tracing_enabled", bool, False, "Emit task/actor spans.")
+define_flag("autoscaling_enabled", bool, False,
+            "Hold cluster-infeasible lease requests (reported as demand "
+            "for the autoscaler to satisfy) instead of failing fast.")
 define_flag("runtime_env_cache_bytes", int, 2 * 1024**3,
             "LRU cap on runtime-env package blobs held in controller "
             "memory; least-recently-used packages are evicted beyond it.")

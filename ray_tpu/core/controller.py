@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .config import RuntimeConfig
 from .ids import ActorID, JobID, NodeID, ObjectID
-from .rpc import RpcClient, RpcError, RpcServer
+from .rpc import RpcClient, RpcError, RpcServer, spawn_task
 
 logger = logging.getLogger("ray_tpu.controller")
 
@@ -48,6 +48,8 @@ class NodeEntry:
     alive: bool = True
     labels: Dict[str, str] = field(default_factory=dict)
     is_head: bool = False
+    idle_s: float = 0.0                 # autoscaler: node idle duration
+    pending_demands: List = field(default_factory=list)
 
 
 @dataclass
@@ -112,6 +114,7 @@ class Controller:
             "list_actors", "cluster_shutdown", "ping", "drain_node",
             "task_events", "list_tasks", "get_task", "list_objects",
             "list_jobs", "report_metrics", "metrics_text",
+            "get_load_metrics",
         ]:
             self.server.register(name, getattr(self, name))
 
@@ -168,7 +171,34 @@ class Controller:
         node.resources_available = p.get("available", node.resources_available)
         if "total" in p:
             node.resources_total = p["total"]
+        node.idle_s = p.get("idle_s", 0.0)
+        node.pending_demands = p.get("pending_demands", [])
         return {"ok": True}
+
+    async def get_load_metrics(self, _p):
+        """Autoscaler input: per-node utilization + unsatisfied demand
+        (ref: autoscaler/_private/load_metrics.py fed from GCS)."""
+        nodes = {}
+        demands = []
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            nodes[n.node_id.hex()] = {
+                "available": dict(n.resources_available),
+                "total": dict(n.resources_total),
+                "idle_s": getattr(n, "idle_s", 0.0),
+                "is_head": n.is_head,
+                "agent_addr": n.agent_addr,
+            }
+            demands.extend(getattr(n, "pending_demands", []))
+        pg_demands = []
+        if self._placement is not None:
+            for entry in self._placement._groups.values():
+                if entry.state in ("PENDING", "RESCHEDULING"):
+                    pg_demands.append({"bundles": list(entry.bundles),
+                                       "strategy": entry.strategy})
+        return {"nodes": nodes, "pending_demands": demands,
+                "pending_placement_groups": pg_demands}
 
     async def list_nodes(self, _p):
         return [
@@ -195,7 +225,8 @@ class Controller:
         cli = await self._agent(p["node_id"])
         if cli is not None:
             try:
-                await cli.call("drain", {})
+                return await cli.call(
+                    "drain", {"if_idle": p.get("if_idle", False)})
             except RpcError:
                 pass
         return {"ok": True}
@@ -295,7 +326,7 @@ class Controller:
             actor.worker_addr = ""
             self._publish("actor", {"actor_id": actor.actor_id,
                                     "state": RESTARTING})
-            asyncio.ensure_future(self._restart_actor(actor))
+            spawn_task(self._restart_actor(actor))
         else:
             actor.state = DEAD
             actor.death_reason = reason
@@ -758,9 +789,9 @@ class Controller:
 
         self._placement = PlacementGroupManager(self)
         await self.server.start(port)
-        asyncio.ensure_future(self._health_loop())
+        spawn_task(self._health_loop())
         if driver_pid:
-            asyncio.ensure_future(self._watch_driver(driver_pid))
+            spawn_task(self._watch_driver(driver_pid))
         return self.server.port
 
     async def _watch_driver(self, pid: int) -> None:
@@ -791,7 +822,9 @@ def main() -> None:
     parser.add_argument("--driver-pid", type=int, default=0)
     args = parser.parse_args()
     logging.basicConfig(
-        level=logging.INFO,
+        level=getattr(logging,
+                      os.environ.get("RT_LOG_LEVEL", "INFO").upper(),
+                      logging.INFO),
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     config = RuntimeConfig.from_env()
 

@@ -35,7 +35,7 @@ from .config import RuntimeConfig
 from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, WorkerID
 from .object_store import SharedObjectStore, StoreDirectory
 from .resources import ResourceSet, node_resources
-from .rpc import RpcClient, RpcError, RpcServer
+from .rpc import RpcClient, RpcError, RpcServer, spawn_task
 
 logger = logging.getLogger("ray_tpu.node_agent")
 
@@ -136,6 +136,23 @@ class NodeAgent:
 
     # -------------------------------------------------------------- startup
     async def start(self, port: int = 0) -> int:
+        # Debug hook: `kill -USR2 <agent pid>` logs every live asyncio
+        # task with its await stack (coroutine-level triage the
+        # faulthandler thread dump can't see).
+        def _dump_tasks(*_a):
+            import traceback
+
+            for t in asyncio.all_tasks():
+                stack = t.get_stack()
+                frames = "".join(traceback.format_stack(stack[-1])) \
+                    if stack else "  <no frames>"
+                logger.error("TASKDUMP %r\n%s", t, frames)
+
+        try:
+            asyncio.get_event_loop().add_signal_handler(
+                signal.SIGUSR2, _dump_tasks)
+        except (NotImplementedError, RuntimeError):
+            pass
         await self.server.start(port)
         self._ctl = RpcClient(self.controller_addr,
                               tag=f"agent-{self.node_id.hex()[:8]}",
@@ -145,8 +162,8 @@ class NodeAgent:
             "node_id": self.node_id, "agent_addr": self.server.address,
             "resources": dict(self.total.amounts), "labels": self.labels,
             "is_head": self.is_head})
-        asyncio.ensure_future(self._heartbeat_loop())
-        asyncio.ensure_future(self._reap_loop())
+        spawn_task(self._heartbeat_loop())
+        spawn_task(self._reap_loop())
         for _ in range(self.config.worker_pool_min_workers):
             self._spawn_worker()
         return self.server.port
@@ -155,13 +172,24 @@ class NodeAgent:
         period = self.config.raylet_heartbeat_period_ms / 1000.0
         misses = 0
         last_metrics = 0.0
+        self._last_busy = time.time()
         while not self._shutdown.is_set():
             try:
+                now = time.time()
+                if self.leases or self.bundles:
+                    self._last_busy = now
+                demands = [dict(req.payload["resources"])
+                           for req in self.pending][:100]
+                demands += list(getattr(self, "_infeasible", []))[:100]
                 r = await self._ctl.call("heartbeat", {
                     "node_id": self.node_id,
                     "available": {k: max(v, 0.0) for k, v in
                                   self.available.amounts.items()},
-                    "total": dict(self.total.amounts)})
+                    "total": dict(self.total.amounts),
+                    # Autoscaler inputs (ref: ray_syncer.proto:31-47
+                    # idle_duration_ms + LoadMetrics demand vector).
+                    "idle_s": now - self._last_busy,
+                    "pending_demands": demands})
                 now = time.time()
                 if now - last_metrics >= \
                         self.config.metrics_report_period_s:
@@ -396,7 +424,7 @@ class NodeAgent:
 
     # ----------------------------------------------------------- scheduling
     def _kick_scheduler(self) -> None:
-        asyncio.ensure_future(self._drain_pending())
+        spawn_task(self._drain_pending())
 
     async def _drain_pending(self) -> None:
         # FIFO with head-of-line skip for infeasible-now requests.
@@ -489,6 +517,13 @@ class NodeAgent:
                 "chip_ids": chip_ids, "node_id": self.node_id}
 
     async def request_lease(self, p):
+        r = await self._request_lease_inner(p)
+        if r is None:  # every branch must answer; never reply None
+            logger.error("request_lease fell through for %r", p)
+            r = {"ok": False, "error": "internal: no lease decision"}
+        return r
+
+    async def _request_lease_inner(self, p):
         """Grant a worker lease, queue, or spill to another node (ref:
         node_manager.cc:1867 HandleRequestWorkerLease +
         hybrid_scheduling_policy.h)."""
@@ -518,11 +553,19 @@ class NodeAgent:
                                                  by_total=True)
                 if target is not None:
                     return {"ok": False, "retry_at": target}
+                if self.config.autoscaling_enabled:
+                    # Hold the request and surface it as demand; the
+                    # autoscaler bin-packs held demands into new nodes
+                    # (ref: cluster_task_manager.h infeasible queue +
+                    # autoscaler LoadMetrics).  Re-probe for a capable
+                    # node until one joins or the request times out.
+                    return await self._await_feasible(p, demand, strategy)
             return {"ok": False,
                     "infeasible": True,
                     "error": f"resources {demand.amounts} can never be "
                              f"satisfied by any alive node "
                              f"(this node total {self.total.amounts})"}
+        # Feasible here eventually: queue until resources free up.
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self.pending.append(_PendingLease(p, fut))
         timeout = p.get("queue_timeout") or 3600.0
@@ -530,6 +573,42 @@ class NodeAgent:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
             return {"ok": False, "error": "lease queue timeout"}
+
+    async def _await_feasible(self, p, demand: ResourceSet,
+                              strategy: str):
+        rec = dict(demand.amounts)
+        infeasible = getattr(self, "_infeasible", None)
+        if infeasible is None:
+            infeasible = self._infeasible = []
+        infeasible.append(rec)
+        rid = p.get("request_id")
+        holds = getattr(self, "_infeasible_holds", None)
+        if holds is None:
+            holds = self._infeasible_holds = {}
+        if rid:
+            holds[rid] = rec
+        deadline = asyncio.get_event_loop().time() + \
+            (p.get("queue_timeout") or 3600.0)
+        try:
+            while asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.5)
+                if rid and rid not in holds:
+                    # cancel_lease_request yanked the hold: stop
+                    # advertising demand for a task nobody wants.
+                    return {"ok": False, "cancelled": True}
+                if self.total.covers(demand):
+                    # A hot-added local resource (not typical) — requeue.
+                    return {"ok": False, "retry_at": self.server.address}
+                target = await self._pick_remote(demand, strategy,
+                                                 by_total=True)
+                if target is not None:
+                    return {"ok": False, "retry_at": target}
+            return {"ok": False, "error": "lease queue timeout "
+                                          "(demand never became feasible)"}
+        finally:
+            infeasible.remove(rec)
+            if rid:
+                holds.pop(rid, None)
 
     async def _pick_remote(self, demand: ResourceSet,
                            strategy: str,
@@ -621,6 +700,13 @@ class NodeAgent:
                     {"ok": False, "cancelled": True})
                 self.pending.remove(req)
                 return {"ok": True, "cancelled": True}
+        holds = getattr(self, "_infeasible_holds", {})
+        if rid in holds:
+            # Held in _await_feasible (cluster-infeasible demand waiting
+            # for the autoscaler): drop the hold; the waiter notices
+            # within its poll tick.
+            del holds[rid]
+            return {"ok": True, "cancelled": True}
         return {"ok": True, "cancelled": False}
 
     async def return_lease(self, p):
@@ -900,7 +986,14 @@ class NodeAgent:
         return {"ok": target is not None}
 
     # -------------------------------------------------------------- admin
-    async def drain(self, _p):
+    async def drain(self, p=None):
+        """Stop accepting leases.  ``if_idle`` (the autoscaler's mode)
+        refuses when leases are active, closing the race where a task is
+        granted between the idle observation and the terminate (ref:
+        DrainRaylet rejection path, node_manager.proto:407)."""
+        if p and p.get("if_idle") and (self.leases or self.pending):
+            return {"ok": False, "busy": True,
+                    "leases": len(self.leases)}
         self._draining = True
         return {"ok": True}
 
@@ -935,7 +1028,7 @@ class NodeAgent:
         self.directory.clear()
         self.store.close()
         asyncio.get_event_loop().call_soon(
-            lambda: asyncio.ensure_future(self.server.stop()))
+            lambda: spawn_task(self.server.stop()))
         return {"ok": True}
 
     async def wait_shutdown(self) -> None:
@@ -955,7 +1048,9 @@ def main() -> None:
     parser.add_argument("--ready-fd", type=int, default=-1)
     args = parser.parse_args()
     logging.basicConfig(
-        level=logging.INFO,
+        level=getattr(logging,
+                      os.environ.get("RT_LOG_LEVEL", "INFO").upper(),
+                      logging.INFO),
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     config = RuntimeConfig.from_env()
     custom = {}

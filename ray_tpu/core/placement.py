@@ -19,7 +19,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from .ids import NodeID, PlacementGroupID
-from .rpc import RpcError
+from .rpc import RpcError, spawn_task
 
 logger = logging.getLogger("ray_tpu.placement")
 
@@ -183,7 +183,7 @@ class PlacementGroupManager:
         entry = PGEntry(pg_id=p["pg_id"], bundles=p["bundles"],
                         strategy=strategy, name=p.get("name", ""))
         self._groups[entry.pg_id] = entry
-        asyncio.ensure_future(self._schedule_loop(entry))
+        spawn_task(self._schedule_loop(entry))
         return {"ok": True}
 
     async def remove(self, p):
@@ -243,4 +243,4 @@ class PlacementGroupManager:
                 self._ctl._publish("placement_group",
                                    {"pg_id": entry.pg_id,
                                     "state": RESCHEDULING})
-                asyncio.ensure_future(self._schedule_loop(entry))
+                spawn_task(self._schedule_loop(entry))

@@ -61,6 +61,29 @@ def _encode_frame(msg: Tuple) -> bytes:
     return len(data).to_bytes(8, "little") + data
 
 
+_BACKGROUND_TASKS: set = set()
+
+
+def spawn_task(coro, loop: Optional[asyncio.AbstractEventLoop] = None
+               ) -> "asyncio.Task":
+    """create_task + a strong reference until completion.
+
+    The event loop holds only WEAK references to tasks: a fire-and-forget
+    task whose only other references sit in its own await chain (task ->
+    coroutine frame -> client -> response future -> task_wakeup callback
+    -> task) is an unrooted cycle the GC may collect while the task is
+    suspended — silently abandoning the work and closing any sockets the
+    frame owned.  Every fire-and-forget spawn in this codebase must come
+    through here (observed in the wild: task submissions vanishing
+    mid-lease under pytest's allocation pattern, surfacing as TCP resets
+    from the driver).
+    """
+    task = (loop or asyncio.get_event_loop()).create_task(coro)
+    _BACKGROUND_TASKS.add(task)
+    task.add_done_callback(_BACKGROUND_TASKS.discard)
+    return task
+
+
 class RpcServer:
     """Serves named async handlers.  ``handler(payload) -> result``.
 
@@ -137,12 +160,10 @@ class RpcServer:
                         peer_tag = payload
                         self._conns[peer_tag] = writer
                         continue
-                    asyncio.ensure_future(
-                        self._dispatch_notify(method, payload))
+                    spawn_task(self._dispatch_notify(method, payload))
                     continue
-                asyncio.ensure_future(
-                    self._dispatch(method, payload, req_id, writer,
-                                   write_lock))
+                spawn_task(self._dispatch(method, payload, req_id,
+                                          writer, write_lock))
         finally:
             self._conns.pop(peer_tag, None)
             if self._conn_lost_cb is not None:
@@ -174,9 +195,11 @@ class RpcServer:
         try:
             if fn is None:
                 raise LookupError(f"no RPC handler {method!r}")
+            logger.debug("srv dispatch %s#%d", method, req_id)
             result = fn(payload)
             if asyncio.iscoroutine(result):
                 result = await result
+            logger.debug("srv reply %s#%d", method, req_id)
             frame = _encode_frame((_RESPONSE, req_id, method, result))
         except BaseException as e:  # noqa: BLE001 — shipped to caller
             try:
@@ -188,8 +211,11 @@ class RpcServer:
             async with write_lock:
                 writer.write(frame)
                 await writer.drain()
-        except (ConnectionError, RuntimeError):
-            pass  # peer went away; nothing to do
+            logger.debug("srv sent %s#%d (%d bytes)", method, req_id,
+                         len(frame))
+        except (ConnectionError, RuntimeError) as e:
+            # Peer went away; the reply has nowhere to go.
+            logger.debug("srv reply %s#%d dropped: %r", method, req_id, e)
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -245,7 +271,7 @@ class RpcClient:
                 self._writer.write(
                     _encode_frame((_NOTIFY, 0, "__register__", self._tag)))
                 await self._writer.drain()
-            self._read_task = asyncio.ensure_future(self._read_loop())
+            self._read_task = spawn_task(self._read_loop())
 
     async def _read_loop(self) -> None:
         assert self._reader is not None
@@ -253,6 +279,10 @@ class RpcClient:
             while True:
                 kind, req_id, _method, payload = await _read_frame(
                     self._reader)
+                logger.debug("cli recv %s#%d <- %s [%x]%s", _method,
+                             req_id, self.address, id(self),
+                             "" if req_id in self._pending
+                             else " (UNMATCHED)")
                 fut = self._pending.pop(req_id, None)
                 if fut is None or fut.done():
                     continue
@@ -286,6 +316,8 @@ class RpcClient:
         self._pending[req_id] = fut
         try:
             assert self._writer is not None
+            logger.debug("cli send %s#%d -> %s [%x]", method, req_id,
+                         self.address, id(self))
             self._writer.write(
                 _encode_frame((_REQUEST, req_id, method, payload)))
             await self._writer.drain()
@@ -345,7 +377,27 @@ class EventLoopThread:
         return fut.result(timeout)
 
     def spawn(self, coro) -> "asyncio.Future":
-        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+        # Route through spawn_task for the strong task reference; the
+        # returned concurrent future mirrors run_coroutine_threadsafe.
+        import concurrent.futures
+
+        done: "concurrent.futures.Future" = concurrent.futures.Future()
+
+        def _start():
+            task = spawn_task(coro, self.loop)
+
+            def _mirror(t):
+                if t.cancelled():
+                    done.cancel()
+                elif t.exception() is not None:
+                    done.set_exception(t.exception())
+                else:
+                    done.set_result(t.result())
+
+            task.add_done_callback(_mirror)
+
+        self.loop.call_soon_threadsafe(_start)
+        return done
 
     def call_soon(self, fn, *args) -> None:
         self.loop.call_soon_threadsafe(fn, *args)

@@ -18,9 +18,11 @@ python/ray/_private/accelerators/tpu.py TPU_VISIBLE_CHIPS).
 from __future__ import annotations
 
 import asyncio
+import faulthandler
 import inspect
 import logging
 import os
+import signal as _signal
 import sys
 import threading
 import time
@@ -36,7 +38,7 @@ from .cluster_runtime import ClusterRuntime
 from .config import RuntimeConfig
 from .errors import ActorError, TaskCancelledError, TaskError
 from .ids import ActorID, JobID, WorkerID
-from .rpc import RpcClient, RpcError, RpcServer
+from .rpc import RpcClient, RpcError, RpcServer, spawn_task
 from .task import ArgKind, TaskResult, TaskSpec
 
 logger = logging.getLogger("ray_tpu.worker")
@@ -96,8 +98,8 @@ class Worker:
             "worker_id": self.worker_id, "addr": self.server.address,
             "pid": os.getpid()})
         self._agent = agent
-        asyncio.ensure_future(self._watch_agent())
-        asyncio.ensure_future(self._flush_loop())
+        spawn_task(self._watch_agent())
+        spawn_task(self._flush_loop())
 
     def _emit_event(self, spec: TaskSpec, state: str, **extra) -> None:
         ev = {"task_id": spec.task_id.hex(), "state": state,
@@ -469,8 +471,14 @@ class Worker:
 
 def main() -> None:
     logging.basicConfig(
-        level=logging.INFO,
+        level=getattr(logging,
+                      os.environ.get("RT_LOG_LEVEL", "INFO").upper(),
+                      logging.INFO),
         format=f"%(asctime)s worker[{os.getpid()}] %(levelname)s %(message)s")
+    # Debug hook: `kill -USR1 <worker pid>` dumps every thread's stack
+    # to the worker log (the reference exposes py-spy via the dashboard;
+    # this is the dependency-free equivalent for hung-worker triage).
+    faulthandler.register(_signal.SIGUSR1, all_threads=True)
 
     async def _run():
         w = Worker()

@@ -150,11 +150,18 @@ class Histogram(Metric):
                                for k, h in self._hist.items()]}
 
 
+def _esc(v: str) -> str:
+    """Prometheus exposition label-value escaping."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
 def _fmt_tags(tags: Dict[str, str], extra: Dict[str, str]) -> str:
     merged = {**tags, **extra}
     if not merged:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(merged.items()))
+    inner = ",".join(f'{k}="{_esc(v)}"'
+                     for k, v in sorted(merged.items()))
     return "{" + inner + "}"
 
 

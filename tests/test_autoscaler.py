@@ -1,0 +1,119 @@
+"""Autoscaler over the fake node provider: demand-driven scale-up
+(tasks, placement groups, TPU slices) and idle scale-down.
+
+Ref: autoscaler/_private/autoscaler.py:171,365 (update loop),
+resource_demand_scheduler.py (bin-packing), fake_multi_node/ (hermetic
+provider) — VERDICT round-1 item 5.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import AutoscalingCluster, NodeType
+
+
+def _wait(pred, timeout=90, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.5)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = AutoscalingCluster(
+        node_types=[
+            NodeType("cpu2", {"CPU": 2}, min_workers=0, max_workers=2),
+            NodeType("v5e-slice", {"TPU": 4, "CPU": 1},
+                     min_workers=0, max_workers=1),
+        ],
+        head_resources={"CPU": 1},
+        idle_timeout_s=4.0,
+        update_interval_s=0.5,
+    )
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_scale_up_for_infeasible_task_then_idle_down(cluster):
+    @ray_tpu.remote(num_cpus=2)
+    def big():
+        return os.getpid()
+
+    # Head has CPU=1: the demand is cluster-infeasible until the
+    # autoscaler launches a cpu2 node.
+    assert ray_tpu.get(big.remote(), timeout=120) > 0
+    assert len(cluster.provider.non_terminated_nodes()) >= 1
+
+    # With the task done and no demand, the idle timeout reaps it.
+    _wait(lambda: len(cluster.provider.non_terminated_nodes()) == 0,
+          what="idle node termination")
+
+
+def test_scale_up_for_placement_group(cluster):
+    from ray_tpu.util import placement_group
+
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(timeout_seconds=120)  # needs a fresh cpu2 node
+    assert len(cluster.provider.non_terminated_nodes()) >= 1
+
+    @ray_tpu.remote(num_cpus=2)
+    def inside():
+        return "pg-ran"
+
+    from ray_tpu.util.scheduling_strategies import \
+        PlacementGroupSchedulingStrategy
+
+    ref = inside.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg)).remote()
+    assert ray_tpu.get(ref, timeout=60) == "pg-ran"
+    ray_tpu.util.remove_placement_group(pg)
+    _wait(lambda: len(cluster.provider.non_terminated_nodes()) == 0,
+          what="post-PG idle termination")
+
+
+def test_scale_up_tpu_slice(cluster):
+    @ray_tpu.remote(num_tpus=4)
+    def on_slice():
+        return os.environ.get("TPU_VISIBLE_CHIPS")
+
+    chips = ray_tpu.get(on_slice.remote(), timeout=120)
+    assert chips is not None and len(chips.split(",")) == 4
+    types = {cluster.provider.node_type_of(p)
+             for p in cluster.provider.non_terminated_nodes()}
+    assert "v5e-slice" in types
+    _wait(lambda: len(cluster.provider.non_terminated_nodes()) == 0,
+          what="slice idle termination", timeout=120)
+
+
+def test_max_workers_respected(cluster):
+    # Demands that would need 3 cpu2 nodes; cap is 2.  The two launched
+    # nodes chew through the queue; the cap is never exceeded.
+    @ray_tpu.remote(num_cpus=2)
+    def slowish():
+        time.sleep(3)
+        return 1
+
+    refs = [slowish.remote() for _ in range(3)]
+    _wait(lambda: len(cluster.provider.non_terminated_nodes()) >= 1,
+          what="scale-up start")
+    peak = 0
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        n = len([p for p in cluster.provider.non_terminated_nodes()
+                 if cluster.provider.node_type_of(p) == "cpu2"])
+        peak = max(peak, n)
+        done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0.1)
+        if len(done) == len(refs):
+            break
+    assert sum(ray_tpu.get(refs, timeout=120)) == 3
+    assert peak <= 2, f"launched {peak} cpu2 nodes, cap is 2"

@@ -68,7 +68,7 @@ def test_cli_start_join_status_stop(session_root):
             "def on_joiner():\n"
             "    return 'joined'\n"
             "pids = ray_tpu.get([pid.remote() for _ in range(5)],"
-            " timeout=60)\n"
+            " timeout=150)\n"
             "assert len(set(pids)) > 1, pids\n"
             "assert ray_tpu.get(on_joiner.remote(), timeout=60) =="
             " 'joined'\n"
@@ -78,7 +78,7 @@ def test_cli_start_join_status_stop(session_root):
         e["PYTHONPATH"] = REPO + os.pathsep + e.get("PYTHONPATH", "")
         res = subprocess.run([sys.executable, "-c", driver],
                              capture_output=True, text=True, env=e,
-                             timeout=120)
+                             timeout=240)
         assert "DRIVER_OK" in res.stdout, res.stderr + res.stdout
     finally:
         out = _rt("stop", env=session_root)
