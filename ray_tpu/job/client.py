@@ -1,0 +1,159 @@
+"""Job submission client.
+
+Role-equivalent to the reference's JobSubmissionClient (ref:
+python/ray/job_submission/sdk.py + dashboard/modules/job/job_manager.py
+submit_job:422): submit an entrypoint, poll status, fetch logs, stop.
+Submission creates the detached supervisor through the normal actor
+path; the read-side endpoints only need the controller KV, so they work
+from any process that can reach the controller.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+TERMINAL = ("SUCCEEDED", "FAILED", "STOPPED")
+
+
+@dataclass
+class JobStatus:
+    job_id: str
+    status: str
+    message: str = ""
+    entrypoint: str = ""
+    metadata: Optional[Dict[str, Any]] = None
+    ts: float = 0.0
+
+    @property
+    def is_terminal(self) -> bool:
+        return self.status in TERMINAL
+
+
+class JobSubmissionClient:
+    def __init__(self, address: Optional[str] = None):
+        from ..core import runtime as runtime_mod
+
+        rt = runtime_mod.get_runtime_quiet()
+        if rt is None or not hasattr(rt, "controller_call"):
+            import ray_tpu
+
+            rt = ray_tpu.init(address=address or "auto")
+        self._rt = rt
+
+    # ------------------------------------------------------------- submit
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, Any]] = None,
+                   num_cpus: float = 0) -> str:
+        """Start ``entrypoint`` under a detached supervisor actor;
+        returns the job id immediately."""
+        import ray_tpu
+
+        from .supervisor import JobSupervisor
+
+        job_id = submission_id or f"job-{uuid.uuid4().hex[:12]}"
+        if not re.fullmatch(r"[A-Za-z0-9_.-]{1,128}", job_id):
+            raise ValueError(
+                f"invalid submission_id {job_id!r}: use letters, digits, "
+                f"'_', '-', '.' (it becomes a KV key segment)")
+        existing = self._status_raw(job_id)
+        if existing is not None:
+            raise ValueError(f"job {job_id!r} already exists")
+        opts: Dict[str, Any] = {
+            "name": f"_job:{job_id}", "lifetime": "detached",
+            "num_cpus": num_cpus,
+        }
+        if runtime_env:
+            opts["runtime_env"] = runtime_env
+        actor_cls = ray_tpu.remote(JobSupervisor)
+        actor = actor_cls.options(**opts).remote(
+            job_id, entrypoint, metadata)
+        # Surface scheduling failures at submit time: the supervisor
+        # writes PENDING from __init__, so a ping proves liveness.
+        ray_tpu.get(actor.ping.remote(), timeout=120)
+        return job_id
+
+    # -------------------------------------------------------------- reads
+    def _status_raw(self, job_id: str) -> Optional[Dict]:
+        raw = self._rt.controller_call(
+            "kv_get", {"key": f"job/{job_id}/status"})
+        return json.loads(raw) if raw else None
+
+    def get_job_status(self, job_id: str) -> JobStatus:
+        raw = self._status_raw(job_id)
+        if raw is None:
+            raise KeyError(f"no such job: {job_id}")
+        if raw["status"] in ("PENDING", "RUNNING") \
+                and not self._supervisor_alive(job_id):
+            # Supervisor died (node loss, OOM): the job can never reach
+            # a terminal state on its own — record the failure (ref:
+            # job_manager.py _monitor_job marking failed supervisors).
+            raw = {**raw, "status": "FAILED",
+                   "message": "job supervisor died"}
+            self._rt.controller_call("kv_put", {
+                "key": f"job/{job_id}/status",
+                "value": json.dumps(raw).encode()})
+        return JobStatus(job_id=job_id, status=raw["status"],
+                         message=raw.get("message", ""),
+                         entrypoint=raw.get("entrypoint", ""),
+                         metadata=raw.get("metadata"),
+                         ts=raw.get("ts", 0.0))
+
+    def _supervisor_alive(self, job_id: str) -> bool:
+        import ray_tpu
+
+        try:
+            actor = ray_tpu.get_actor(f"_job:{job_id}")
+            return bool(ray_tpu.get(actor.ping.remote(), timeout=15))
+        except Exception:
+            return False
+
+    def get_job_logs(self, job_id: str) -> str:
+        raw = self._rt.controller_call(
+            "kv_get", {"key": f"job/{job_id}/logs"})
+        if raw is None and self._status_raw(job_id) is None:
+            raise KeyError(f"no such job: {job_id}")
+        return (raw or b"").decode(errors="replace")
+
+    def list_jobs(self) -> List[JobStatus]:
+        keys = self._rt.controller_call(
+            "kv_keys", {"prefix": "job/"})
+        out = []
+        for key in keys:
+            if not key.endswith("/status"):
+                continue
+            job_id = key.split("/", 2)[1]
+            try:
+                out.append(self.get_job_status(job_id))
+            except KeyError:
+                continue
+        return sorted(out, key=lambda s: s.ts)
+
+    # ------------------------------------------------------------ control
+    def stop_job(self, job_id: str) -> bool:
+        import ray_tpu
+
+        self.get_job_status(job_id)  # raises if unknown
+        try:
+            actor = ray_tpu.get_actor(f"_job:{job_id}")
+            return ray_tpu.get(actor.stop.remote(), timeout=30)
+        except Exception:
+            return False
+
+    def wait_until_finished(self, job_id: str, timeout: float = 300,
+                            poll_s: float = 0.5) -> JobStatus:
+        deadline = time.time() + timeout
+        while True:
+            st = self.get_job_status(job_id)
+            if st.is_terminal:
+                return st
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"job {job_id} still {st.status} after {timeout}s")
+            time.sleep(poll_s)

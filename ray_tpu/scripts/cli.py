@@ -291,6 +291,80 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def _job_client(address: str):
+    import ray_tpu
+    from ray_tpu.job import JobSubmissionClient
+
+    addr = resolve_address(address=address)
+    if not addr:
+        print("No running cluster found.", file=sys.stderr)
+        raise SystemExit(1)
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(address=addr)
+    return JobSubmissionClient(addr)
+
+
+def cmd_job(args) -> int:
+    try:
+        return _cmd_job_inner(args)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 1
+    except (ValueError, TimeoutError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+def _cmd_job_inner(args) -> int:
+    client = _job_client(args.address)
+    if args.job_command == "submit":
+        renv = {}
+        if args.working_dir:
+            renv["working_dir"] = args.working_dir
+        if args.env:
+            bad = [kv for kv in args.env if "=" not in kv]
+            if bad:
+                print(f"error: --env needs K=V form, got {bad}",
+                      file=sys.stderr)
+                return 2
+            renv["env_vars"] = dict(kv.split("=", 1) for kv in args.env)
+        ep = args.entrypoint
+        if ep[:1] == ["--"]:
+            ep = ep[1:]
+        if not ep:
+            print("error: no entrypoint given", file=sys.stderr)
+            return 2
+        job_id = client.submit_job(
+            entrypoint=" ".join(ep),
+            submission_id=args.id or None,
+            runtime_env=renv or None)
+        print(f"Submitted {job_id}")
+        if args.wait:
+            st = client.wait_until_finished(job_id,
+                                            timeout=args.timeout)
+            sys.stdout.write(client.get_job_logs(job_id))
+            print(f"Job {job_id}: {st.status} {st.message}")
+            return 0 if st.status == "SUCCEEDED" else 1
+        return 0
+    if args.job_command == "status":
+        st = client.get_job_status(args.id)
+        print(f"{st.job_id}: {st.status}"
+              + (f" ({st.message})" if st.message else ""))
+        return 0 if st.status != "FAILED" else 1
+    if args.job_command == "logs":
+        sys.stdout.write(client.get_job_logs(args.id))
+        return 0
+    if args.job_command == "stop":
+        ok = client.stop_job(args.id)
+        print("stopped" if ok else "not running")
+        return 0
+    if args.job_command == "list":
+        for st in client.list_jobs():
+            print(f"{st.job_id}  {st.status:<10} {st.entrypoint}")
+        return 0
+    return 2
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="rt", description="ray_tpu cluster CLI")
@@ -348,6 +422,29 @@ def _build_parser() -> argparse.ArgumentParser:
                         help="print Prometheus metrics exposition")
     sp.add_argument("--address", default="")
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("job", help="submit and manage cluster jobs")
+    jsub = sp.add_subparsers(dest="job_command", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                   help="shell command (prefix with -- )")
+    j.add_argument("--id", default="")
+    j.add_argument("--address", default="")
+    j.add_argument("--working-dir", default="")
+    j.add_argument("--env", action="append", default=[],
+                   metavar="K=V")
+    j.add_argument("--wait", action="store_true",
+                   help="block until the job finishes; print its logs")
+    j.add_argument("--timeout", type=float, default=3600)
+    j.set_defaults(fn=cmd_job)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("id")
+        j.add_argument("--address", default="")
+        j.set_defaults(fn=cmd_job)
+    j = jsub.add_parser("list")
+    j.add_argument("--address", default="")
+    j.set_defaults(fn=cmd_job)
     return p
 
 

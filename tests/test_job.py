@@ -1,0 +1,111 @@
+"""Job submission: entrypoint runs under a detached supervisor actor,
+status/logs in the controller KV, stop, and survival of client exit.
+
+Ref: dashboard/modules/job/job_manager.py:59,422 + job_supervisor.py:54
+— VERDICT round-1 missing item 5.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job import JobSubmissionClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def rt():
+    handle = ray_tpu.init(mode="cluster", num_cpus=2)
+    yield handle
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def client(rt):
+    return JobSubmissionClient()
+
+
+def test_submit_and_succeed(client):
+    job_id = client.submit_job(
+        entrypoint="echo hello-from-job && echo line2 >&2")
+    st = client.wait_until_finished(job_id, timeout=60)
+    assert st.status == "SUCCEEDED", (st.status, st.message)
+    logs = client.get_job_logs(job_id)
+    assert "hello-from-job" in logs
+    assert "line2" in logs  # stderr folded into the same stream
+    assert any(j.job_id == job_id for j in client.list_jobs())
+
+
+def test_failing_job(client):
+    job_id = client.submit_job(entrypoint="echo boom; exit 3")
+    st = client.wait_until_finished(job_id, timeout=60)
+    assert st.status == "FAILED"
+    assert "3" in st.message
+    assert "boom" in client.get_job_logs(job_id)
+
+
+def test_stop_job(client):
+    job_id = client.submit_job(entrypoint="sleep 60")
+    deadline = time.time() + 30
+    while client.get_job_status(job_id).status == "PENDING":
+        assert time.time() < deadline
+        time.sleep(0.2)
+    assert client.stop_job(job_id)
+    st = client.wait_until_finished(job_id, timeout=30)
+    assert st.status == "STOPPED"
+
+
+def test_job_env_vars_and_metadata(client):
+    job_id = client.submit_job(
+        entrypoint='echo "flavor=$JOBTEST_FLAVOR"',
+        runtime_env={"env_vars": {"JOBTEST_FLAVOR": "vanilla"}},
+        metadata={"owner": "tests"})
+    st = client.wait_until_finished(job_id, timeout=90)
+    assert st.status == "SUCCEEDED", (st.status, st.message)
+    assert "flavor=vanilla" in client.get_job_logs(job_id)
+    assert st.metadata == {"owner": "tests"}
+
+
+def test_duplicate_id_rejected(client):
+    job_id = client.submit_job(entrypoint="true",
+                               submission_id="job-dup-test")
+    client.wait_until_finished(job_id, timeout=60)
+    with pytest.raises(ValueError):
+        client.submit_job(entrypoint="true", submission_id="job-dup-test")
+
+
+def test_unknown_job(client):
+    with pytest.raises(KeyError):
+        client.get_job_status("job-nope")
+
+
+def test_job_survives_submitting_process(rt):
+    """The supervisor is detached: a job submitted by a short-lived
+    client keeps running and its result is visible to a later one
+    (ref: job supervisor lifetime, job_manager.py _monitor_job)."""
+    addr = rt.controller_addr
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import ray_tpu\n"
+        "from ray_tpu.job import JobSubmissionClient\n"
+        "ray_tpu.init(address=%r)\n"
+        "c = JobSubmissionClient()\n"
+        "print(c.submit_job(entrypoint='sleep 2; echo survived',"
+        " submission_id='job-detach'))\n"
+    ) % (REPO, addr)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, env=env,
+                         timeout=120)
+    assert "job-detach" in res.stdout, res.stderr
+    # The submitting driver is gone; poll from this process.
+    client = JobSubmissionClient()
+    st = client.wait_until_finished("job-detach", timeout=60)
+    assert st.status == "SUCCEEDED", (st.status, st.message)
+    assert "survived" in client.get_job_logs("job-detach")
