@@ -94,10 +94,24 @@ class Dataset:
         return len(self._sources)
 
     def _execute_refs(self) -> Iterator[Any]:
-        """Stream block refs with a bounded in-flight window."""
+        """Stream block refs under a byte-budgeted in-flight window.
+
+        The streaming executor (ref: streaming_executor.py:48, scheduling
+        loop :233): the whole operator chain runs fused inside ONE task
+        per block (no intermediate materialization — the reference fuses
+        compatible map operators the same way), and admission is bounded
+        by estimated in-flight BYTES (backpressure; ref: resource
+        manager + backpressure policies), not a fixed task count.  The
+        size estimate starts at DataContext.initial_block_size_estimate
+        and tracks an EMA of observed completed-block sizes.  Yields in
+        source order so row order stays deterministic; consumed refs are
+        dropped by the caller, so ref-counting frees finished blocks and
+        a dataset larger than the object store streams through.
+        """
         import ray_tpu
         from ..core import runtime as _rt
         from ..core import serialization
+        from .context import DataContext
 
         if self._materialized is not None:
             for b in self._materialized:
@@ -105,17 +119,33 @@ class Dataset:
             return
         for op in self._ops:
             serialization.ensure_code_portable(op.fn)
+        ctx = DataContext.get_current()
         remote_fn = ray_tpu.remote(_process_block)
         inflight: List[Any] = []
         pending = list(self._sources)
-        # Submit with a bounded window but yield in SOURCE order (head of
-        # line) so row order is deterministic.
+        est = float(ctx.initial_block_size_estimate)
+        rt = _rt.get_runtime()
+
+        def budget_allows() -> bool:
+            if not inflight:
+                return True  # always keep at least one task running
+            if len(inflight) >= ctx.max_concurrent_tasks:
+                return False
+            return (len(inflight) + 1) * est <= ctx.max_in_flight_bytes
+
         while pending or inflight:
-            while pending and len(inflight) < self._window:
+            while pending and budget_allows():
                 src = pending.pop(0)
                 inflight.append(remote_fn.remote(src, self._ops))
             head = inflight.pop(0)
             ray_tpu.wait([head], num_returns=1)
+            try:
+                loc = rt.controller_call(
+                    "locate_object", {"object_id": head.id})
+                if loc and loc.get("size"):
+                    est = 0.7 * est + 0.3 * float(loc["size"])
+            except Exception:
+                pass  # inline result or transient error: keep estimate
             yield ("ref", head)
 
     def _iter_blocks(self) -> Iterator[Block]:
@@ -198,8 +228,17 @@ class Dataset:
 
     # ----------------------------------------------------------- barriers
     def split(self, n: int, *, equal: bool = True) -> List["Dataset"]:
-        """Split into n datasets (for per-worker shards).  Splits at block
-        granularity when possible, else row granularity."""
+        """Split into n datasets (for per-worker shards).  When the
+        source-block count divides evenly, the split is LAZY — each
+        shard keeps its slice of sources + the op chain and streams
+        independently (the reference's streaming_split; nothing
+        materializes on the driver).  Otherwise falls back to
+        row-granularity (materializing)."""
+        if self._materialized is None and len(self._sources) >= n \
+                and len(self._sources) % n == 0:
+            per = len(self._sources) // n
+            return [Dataset(self._sources[i * per:(i + 1) * per],
+                            self._ops, self._window) for i in range(n)]
         blocks = list(self._iter_blocks())
         if len(blocks) >= n and len(blocks) % n == 0:
             per = len(blocks) // n

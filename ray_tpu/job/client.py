@@ -68,6 +68,9 @@ class JobSubmissionClient:
         opts: Dict[str, Any] = {
             "name": f"_job:{job_id}", "lifetime": "detached",
             "num_cpus": num_cpus,
+            # ping()/stop() must stay serviceable while a blocking
+            # wait() call occupies one slot.
+            "max_concurrency": 4,
         }
         if runtime_env:
             opts["runtime_env"] = runtime_env
@@ -91,14 +94,25 @@ class JobSubmissionClient:
             raise KeyError(f"no such job: {job_id}")
         if raw["status"] in ("PENDING", "RUNNING") \
                 and not self._supervisor_alive(job_id):
-            # Supervisor died (node loss, OOM): the job can never reach
-            # a terminal state on its own — record the failure (ref:
-            # job_manager.py _monitor_job marking failed supervisors).
-            raw = {**raw, "status": "FAILED",
-                   "message": "job supervisor died"}
-            self._rt.controller_call("kv_put", {
-                "key": f"job/{job_id}/status",
-                "value": json.dumps(raw).encode()})
+            # Supervisor might be dead — but a single missed ping can be
+            # load, not death (and a FAILED write is visible to every
+            # observer).  Require repeated failures over a real window
+            # before declaring it (ref: job_manager.py _monitor_job).
+            fails = getattr(self, "_liveness_fails", None)
+            if fails is None:
+                fails = self._liveness_fails = {}
+            count, first = fails.get(job_id, (0, time.time()))
+            count += 1
+            fails[job_id] = (count, first)
+            if count >= 3 and time.time() - first >= 10.0:
+                raw = {**raw, "status": "FAILED",
+                       "message": "job supervisor died"}
+                self._rt.controller_call("kv_put", {
+                    "key": f"job/{job_id}/status",
+                    "value": json.dumps(raw).encode()})
+                fails.pop(job_id, None)
+        else:
+            getattr(self, "_liveness_fails", {}).pop(job_id, None)
         return JobStatus(job_id=job_id, status=raw["status"],
                          message=raw.get("message", ""),
                          entrypoint=raw.get("entrypoint", ""),

@@ -56,9 +56,19 @@ def run(app: Application, *, name: str = "default",
         prefix = d.route_prefix
         if is_root and prefix is None:
             prefix = route_prefix
+        autoscaling = None
+        if d.autoscaling_config is not None:
+            ac = d.autoscaling_config
+            autoscaling = {
+                "min_replicas": ac.min_replicas,
+                "max_replicas": ac.max_replicas,
+                "target_ongoing_requests": ac.target_ongoing_requests,
+                "upscale_delay_s": ac.upscale_delay_s,
+                "downscale_delay_s": ac.downscale_delay_s,
+            }
         ray_tpu.get(ctl.deploy.remote(
             d.name, payload, args, kwargs, d.num_replicas,
-            d.is_function, prefix, d.ray_actor_options))
+            d.is_function, prefix, d.ray_actor_options, autoscaling))
         return DeploymentHandle(d.name)
 
     handle = deploy_app(app, True)

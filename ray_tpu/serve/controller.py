@@ -70,73 +70,233 @@ class _Replica:
 
 
 class ServeController:
-    """Named actor: deployment table + replica reconciliation."""
+    """Named actor: deployment table + replica reconciliation.
+
+    A background control loop (ref: serve/_private/controller.py
+    run_control_loop + deployment_state.py update cycle) continuously:
+    - health-checks replicas and replaces dead ones WITHOUT waiting for
+      a request to fail into them, and
+    - autoscales deployments on observed ongoing-request load (ref:
+      autoscaling_state.py — redesigned pull-based: the loop samples
+      replica queue depths instead of receiving pushed metrics).
+    """
 
     def __init__(self):
+        import threading
+
         self.deployments: Dict[str, Dict[str, Any]] = {}
+        # The control loop shares self.deployments with actor-method
+        # threads (max_concurrency > 1): every structural mutation holds
+        # this lock; slow RPCs happen outside it with a generation check
+        # on re-entry (ref: deployment_state's single-threaded update
+        # loop — redesigned lock+generation since our methods are
+        # threaded).
+        self._lock = threading.RLock()
+        self._loop_stop = threading.Event()
+        self._loop_thread = threading.Thread(
+            target=self._control_loop, daemon=True,
+            name="serve-control-loop")
+        self._loop_thread.start()
 
     def deploy(self, name: str, cls_payload: bytes, init_args: tuple,
                init_kwargs: dict, num_replicas: int, is_function: bool,
                route_prefix: Optional[str],
-               actor_options: Dict[str, Any]) -> bool:
-        entry = self.deployments.get(name)
-        if entry is None:
-            entry = self.deployments[name] = {
-                "replicas": [], "route_prefix": route_prefix,
-                "target": num_replicas, "payload": cls_payload,
-                "init": (init_args, init_kwargs),
-                "is_function": is_function,
-                "actor_options": actor_options}
-        else:
-            entry.update(payload=cls_payload,
-                         init=(init_args, init_kwargs),
-                         target=num_replicas, route_prefix=route_prefix,
-                         is_function=is_function,
-                         actor_options=actor_options)
-            # Redeploy: drop old replicas, fresh code/config.
-            for r in entry["replicas"]:
+               actor_options: Dict[str, Any],
+               autoscaling: Optional[Dict[str, Any]] = None) -> bool:
+        fresh = {
+            "route_prefix": route_prefix,
+            "target": num_replicas, "payload": cls_payload,
+            "init": (init_args, init_kwargs),
+            "is_function": is_function,
+            "actor_options": actor_options,
+            "autoscaling": autoscaling,
+            "scale_up_since": None, "scale_down_since": None,
+        }
+        if autoscaling:
+            fresh["target"] = max(autoscaling["min_replicas"], 1)
+        with self._lock:
+            entry = self.deployments.get(name)
+            if entry is None:
+                entry = self.deployments[name] = {
+                    "replicas": [], "draining": [], "gen": 0, **fresh}
+            else:
+                entry.update(fresh)
+                entry["gen"] += 1
+                # Redeploy: drop old replicas, fresh code/config.
+                for r in entry["replicas"]:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+                entry["replicas"] = []
+            self.reconcile(name)
+        return True
+
+    # ------------------------------------------------------- control loop
+    def _control_loop(self) -> None:
+        while not self._loop_stop.wait(1.0):
+            for name in list(self.deployments):
                 try:
-                    ray_tpu.kill(r)
+                    self._heal_and_autoscale(name)
+                except KeyError:
+                    continue  # deleted mid-pass
+                except Exception:
+                    pass  # next tick retries; the loop must survive
+
+    @staticmethod
+    def _batched_probe(refs: List[Any], timeout: float) -> List[Any]:
+        """Resolve many probe refs under ONE shared timeout; returns a
+        value per ref or an Exception marker (a single dead replica must
+        not serialize the loop into per-replica timeouts)."""
+        try:
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                    timeout=timeout)
+        except Exception:
+            ready = []
+        ready_set = {r.id for r in ready}
+        out: List[Any] = []
+        for ref in refs:
+            if ref.id not in ready_set:
+                out.append(TimeoutError("probe timeout"))
+                continue
+            try:
+                out.append(ray_tpu.get(ref, timeout=1))
+            except Exception as e:  # noqa: BLE001 — dead replica marker
+                out.append(e)
+        return out
+
+    def _heal_and_autoscale(self, name: str) -> None:
+        """One tick: batched health + load probe, replace dead replicas
+        (ref: deployment_state.py health checks — round 1 only healed on
+        request failure), then request-based autoscaling (ref:
+        autoscaling_state.py, pull-based redesign)."""
+        with self._lock:
+            entry = self.deployments[name]
+            gen = entry["gen"]
+            replicas = list(entry["replicas"])
+            self._reap_draining(entry)
+        if not replicas:
+            return
+        health_refs = [r.health.remote() for r in replicas]
+        ongoing_refs = [r.ongoing.remote() for r in replicas]
+        health = self._batched_probe(health_refs, timeout=10)
+        ongoing = self._batched_probe(ongoing_refs, timeout=5)
+        with self._lock:
+            entry = self.deployments.get(name)
+            if entry is None or entry["gen"] != gen:
+                return  # redeployed/deleted while probing; stale view
+            for i, h in enumerate(health):
+                if isinstance(h, Exception):
+                    self.replace_dead_replica(name, i)
+            counts = [v for v in ongoing
+                      if not isinstance(v, Exception)]
+            self._autoscale_locked(entry, name, counts)
+
+    def _reap_draining(self, entry: Dict[str, Any]) -> None:
+        """Kill drained scale-down victims: immediately once idle, or
+        after a 30 s grace (the reference drains before termination)."""
+        still = []
+        for rec in entry.get("draining", []):
+            replica, since, ongoing_ref = rec
+            kill = False
+            try:
+                ready, _ = ray_tpu.wait([ongoing_ref], timeout=0.5)
+                if ready and ray_tpu.get(ongoing_ref, timeout=1) == 0:
+                    kill = True
+            except Exception:
+                kill = True  # already dead
+            if kill or time.time() - since > 30.0:
+                try:
+                    ray_tpu.kill(replica)
                 except Exception:
                     pass
-            entry["replicas"] = []
-        self.reconcile(name)
-        return True
+            else:
+                still.append((replica, since,
+                              replica.ongoing.remote()))
+        entry["draining"] = still
+
+    def _autoscale_locked(self, entry: Dict[str, Any], name: str,
+                          ongoing: List[int]) -> None:
+        cfg = entry.get("autoscaling")
+        if not cfg or not ongoing:
+            return
+        total = sum(ongoing)
+        import math
+
+        desired = math.ceil(total / cfg["target_ongoing_requests"])
+        desired = min(max(desired, cfg["min_replicas"]),
+                      cfg["max_replicas"])
+        current = entry["target"]
+        now = time.time()
+        if desired > current:
+            entry["scale_down_since"] = None
+            if entry["scale_up_since"] is None:
+                entry["scale_up_since"] = now
+            if now - entry["scale_up_since"] >= cfg["upscale_delay_s"]:
+                entry["target"] = desired
+                entry["scale_up_since"] = None
+                self.reconcile(name)
+        elif desired < current:
+            entry["scale_up_since"] = None
+            if entry["scale_down_since"] is None:
+                entry["scale_down_since"] = now
+            if now - entry["scale_down_since"] >= \
+                    cfg["downscale_delay_s"]:
+                entry["target"] = desired
+                entry["scale_down_since"] = None
+                self.reconcile(name)
+        else:
+            entry["scale_up_since"] = None
+            entry["scale_down_since"] = None
 
     def reconcile(self, name: str) -> int:
-        entry = self.deployments[name]
-        replica_cls = ray_tpu.remote(_Replica).options(
-            max_concurrency=32, **entry.get("actor_options", {}))
-        while len(entry["replicas"]) < entry["target"]:
-            args, kwargs = entry["init"]
-            entry["replicas"].append(replica_cls.remote(
-                entry["payload"], args, kwargs, entry["is_function"]))
-        while len(entry["replicas"]) > entry["target"]:
-            victim = entry["replicas"].pop()
-            try:
-                ray_tpu.kill(victim)
-            except Exception:
-                pass
-        return len(entry["replicas"])
+        with self._lock:
+            entry = self.deployments[name]
+            if len(entry["replicas"]) != entry["target"]:
+                entry["gen"] += 1  # invalidate in-flight probe passes
+            replica_cls = ray_tpu.remote(_Replica).options(
+                max_concurrency=32, **entry.get("actor_options", {}))
+            while len(entry["replicas"]) < entry["target"]:
+                args, kwargs = entry["init"]
+                entry["replicas"].append(replica_cls.remote(
+                    entry["payload"], args, kwargs,
+                    entry["is_function"]))
+            while len(entry["replicas"]) > entry["target"]:
+                victim = entry["replicas"].pop()
+                # Drain, don't kill: in-flight requests finish; the
+                # control loop reaps once idle (30 s grace cap).
+                entry.setdefault("draining", []).append(
+                    (victim, time.time(), victim.ongoing.remote()))
+            return len(entry["replicas"])
 
     def scale(self, name: str, num_replicas: int) -> int:
-        self.deployments[name]["target"] = num_replicas
-        return self.reconcile(name)
+        with self._lock:
+            self.deployments[name]["target"] = num_replicas
+            return self.reconcile(name)
 
     def replace_dead_replica(self, name: str, index: int) -> bool:
-        entry = self.deployments.get(name)
-        if entry is None or index >= len(entry["replicas"]):
-            return False
-        args, kwargs = entry["init"]
-        replica_cls = ray_tpu.remote(_Replica).options(
-            max_concurrency=32, **entry.get("actor_options", {}))
-        entry["replicas"][index] = replica_cls.remote(
-            entry["payload"], args, kwargs, entry["is_function"])
-        return True
+        with self._lock:
+            entry = self.deployments.get(name)
+            if entry is None or index >= len(entry["replicas"]):
+                return False
+            # Kill the old ref: a "dead" verdict can be a saturated-but-
+            # alive replica that missed the health deadline; leaving it
+            # running would leak its resources forever.
+            try:
+                ray_tpu.kill(entry["replicas"][index])
+            except Exception:
+                pass
+            args, kwargs = entry["init"]
+            replica_cls = ray_tpu.remote(_Replica).options(
+                max_concurrency=32, **entry.get("actor_options", {}))
+            entry["replicas"][index] = replica_cls.remote(
+                entry["payload"], args, kwargs, entry["is_function"])
+            return True
 
     def get_replicas(self, name: str) -> List[Any]:
-        entry = self.deployments.get(name)
-        return entry["replicas"] if entry else []
+        with self._lock:
+            entry = self.deployments.get(name)
+            return list(entry["replicas"]) if entry else []
 
     def routes(self) -> Dict[str, str]:
         return {e["route_prefix"]: name
@@ -150,9 +310,11 @@ class ServeController:
                 for name, e in self.deployments.items()}
 
     def delete(self, name: str) -> bool:
-        entry = self.deployments.pop(name, None)
+        with self._lock:
+            entry = self.deployments.pop(name, None)
         if entry:
-            for r in entry["replicas"]:
+            drained = [rec[0] for rec in entry.get("draining", [])]
+            for r in entry["replicas"] + drained:
                 try:
                     ray_tpu.kill(r)
                 except Exception:

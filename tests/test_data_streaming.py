@@ -1,0 +1,158 @@
+"""Streaming executor: byte-budgeted backpressure, out-of-core
+datasets, lazy split feeding a training loop.
+
+Ref: data/_internal/execution/streaming_executor.py:48,233 + resource
+manager backpressure — VERDICT round-1 item 6 ("Data execution window is
+a constant 4" / materialize() pulls everything through the driver).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rt_data
+from ray_tpu.data.context import DataContext
+
+
+@pytest.fixture(scope="module")
+def small_store_rt():
+    # Object store smaller than the dataset: only streaming (with
+    # consumed-block freeing) can push the whole dataset through.
+    rt = ray_tpu.init(mode="cluster", num_cpus=2,
+                      config={"object_store_memory_bytes": 48 * 1024**2})
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_out_of_core_streaming(small_store_rt):
+    n_blocks, rows_per_block = 24, 1000
+    # Each block ~4 MB after map_batches => ~96 MB total through a 48 MB
+    # store.
+    def make_source(i):
+        def src():
+            from ray_tpu.data.block import build_block
+
+            return build_block([{"i": i * rows_per_block + j}
+                                for j in range(rows_per_block)])
+        return src
+
+    ds = rt_data.Dataset([make_source(i) for i in range(n_blocks)])
+
+    def widen(batch):
+        n = len(batch["i"])
+        return {"i": batch["i"],
+                "payload": np.ones((n, 1024), np.float32)}
+
+    ds = ds.map_batches(widen)
+    seen = 0
+    total_i = 0
+    for batch in ds.iter_batches(batch_size=500):
+        assert batch["payload"].shape[1] == 1024
+        seen += len(batch["i"])
+        total_i += int(batch["i"].sum())
+    n = n_blocks * rows_per_block
+    assert seen == n
+    assert total_i == n * (n - 1) // 2  # every row exactly once, ordered
+
+
+def test_backpressure_bounds_inflight(small_store_rt):
+    """With a tiny byte budget, at most ~1-2 tasks run concurrently."""
+    ctx = DataContext.get_current()
+    old = (ctx.max_in_flight_bytes, ctx.initial_block_size_estimate)
+    ctx.max_in_flight_bytes = 1  # forces the keep-one-running minimum
+    ctx.initial_block_size_estimate = 1024
+    try:
+        peak = {"v": 0}
+
+        @ray_tpu.remote
+        class Gauge:
+            def __init__(self):
+                self.cur = 0
+                self.peak = 0
+
+            def enter(self):
+                self.cur += 1
+                self.peak = max(self.peak, self.cur)
+
+            def exit(self):
+                self.cur -= 1
+
+            def get_peak(self):
+                return self.peak
+
+        gauge = Gauge.options(name="bp_gauge").remote()
+
+        def make_source(i):
+            def src():
+                import time
+
+                import ray_tpu
+                from ray_tpu.data.block import build_block
+
+                g = ray_tpu.get_actor("bp_gauge")
+                ray_tpu.get(g.enter.remote())
+                time.sleep(0.1)
+                ray_tpu.get(g.exit.remote())
+                return build_block([{"x": i}])
+            return src
+
+        ds = rt_data.Dataset([make_source(i) for i in range(8)])
+        assert ds.count() == 8
+        peak["v"] = ray_tpu.get(gauge.get_peak.remote())
+        assert peak["v"] <= 2, f"backpressure ignored: peak={peak['v']}"
+        ray_tpu.kill(gauge)
+    finally:
+        ctx.max_in_flight_bytes, ctx.initial_block_size_estimate = old
+
+
+def test_lazy_split_streams_into_training_loop(small_store_rt):
+    """split() without materializing: each shard streams its own
+    sources; a training-style consumer iterates batches per epoch."""
+    calls = []
+
+    def make_source(i):
+        def src():
+            from ray_tpu.data.block import build_block
+
+            return build_block([{"v": float(i * 10 + j)}
+                                for j in range(10)])
+        return src
+
+    ds = rt_data.Dataset([make_source(i) for i in range(8)])
+    ds = ds.map_batches(lambda b: {"v": b["v"] * 2})
+    shards = ds.split(4)
+    assert all(s._materialized is None for s in shards)
+
+    per_shard_rows = []
+    for shard in shards:
+        rows = 0
+        sum_v = 0.0
+        for _epoch in range(2):  # re-iterable: per-epoch streaming
+            for batch in shard.iter_batches(batch_size=8):
+                rows += len(batch["v"])
+                sum_v += float(batch["v"].sum())
+        per_shard_rows.append(rows)
+    assert per_shard_rows == [40, 40, 40, 40]
+    del calls
+
+
+def test_tensor_block_arrow_roundtrip(small_store_rt, tmp_path):
+    """Multi-dim columns survive to_arrow/write_parquet (FixedSizeList)
+    and pandas conversion."""
+    def src():
+        from ray_tpu.data.block import build_block
+
+        return build_block([{"v": np.arange(3, dtype=np.float32) + i}
+                            for i in range(4)])
+
+    ds = rt_data.Dataset([src])
+    out = tmp_path / "pq"
+    ds.write_parquet(str(out))
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(str(out))
+    assert table.num_rows == 4
+    first = np.asarray(table.column("v")[0].as_py())
+    np.testing.assert_allclose(first, [0, 1, 2])
+    df = ds.iter_batches(batch_size=4, batch_format="pandas")
+    assert len(next(iter(df))) == 4

@@ -120,3 +120,80 @@ def test_http_ingress():
         raise AssertionError("expected 404")
     except urllib.error.HTTPError as e:
         assert e.code == 404
+
+
+def test_dead_replica_healed_without_request():
+    """The control loop replaces a killed replica with no request sent
+    (ref: deployment_state.py health checks — VERDICT weak item 9)."""
+
+    @serve.deployment(num_replicas=2)
+    def stable(x):
+        return x * 2
+
+    handle = serve.run(stable.bind(), route_prefix="/stable")
+    assert ray_tpu.get(handle.remote(21)) == 42
+
+    ctl = ray_tpu.get_actor(serve.CONTROLLER_NAME)
+    replicas = ray_tpu.get(ctl.get_replicas.remote("stable"))
+    victim = replicas[0]
+    ray_tpu.kill(victim)
+
+    # No traffic at all; the loop must heal on its own.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        fresh = ray_tpu.get(ctl.get_replicas.remote("stable"))
+        if len(fresh) == 2 and victim._actor_id not in \
+                [r._actor_id for r in fresh]:
+            try:
+                assert ray_tpu.get(
+                    fresh[0].health.remote(), timeout=30)
+                break
+            except Exception:
+                pass
+        time.sleep(0.5)
+    else:
+        raise TimeoutError("dead replica never replaced")
+    # And the deployment still serves.
+    assert ray_tpu.get(handle.remote(5)) == 10
+
+
+def test_request_autoscaling_up_and_down():
+    """Load scales 1 -> N; idle scales back down (ref:
+    serve/_private/autoscaling_state.py — VERDICT item 8)."""
+
+    @serve.deployment(autoscaling_config=serve.AutoscalingConfig(
+        min_replicas=1, max_replicas=3, target_ongoing_requests=1.0,
+        upscale_delay_s=0.5, downscale_delay_s=2.0))
+    def slow(x):
+        time.sleep(0.4)
+        return x
+
+    handle = serve.run(slow.bind(), route_prefix="/slow")
+    ctl = ray_tpu.get_actor(serve.CONTROLLER_NAME)
+    assert len(ray_tpu.get(ctl.get_replicas.remote("slow"))) == 1
+
+    # Sustained concurrent load -> more replicas.
+    stop = time.time() + 25
+    peak = 1
+    inflight = []
+    while time.time() < stop:
+        inflight = [r for r in inflight
+                    if not ray_tpu.wait([r], timeout=0)[0]]
+        while len(inflight) < 6:
+            inflight.append(handle.remote(1))
+        peak = max(peak, len(
+            ray_tpu.get(ctl.get_replicas.remote("slow"))))
+        if peak >= 2:
+            break
+        time.sleep(0.3)
+    assert peak >= 2, "never scaled up under load"
+    ray_tpu.get(inflight)  # drain
+
+    # Idle -> back down to min.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if len(ray_tpu.get(ctl.get_replicas.remote("slow"))) == 1:
+            break
+        time.sleep(0.5)
+    else:
+        raise TimeoutError("never scaled back down")
