@@ -6,7 +6,12 @@ legacy policy/evaluation stack is intentionally not replicated, per
 SURVEY.md §7 hard-parts note).
 """
 
+from .actor_manager import FaultTolerantActorManager  # noqa: F401
 from .algorithm import PPO, AlgorithmConfig  # noqa: F401
+from .dqn import (DQN, DQNConfig, DQNEnvRunner, DQNJaxLearner,  # noqa
+                  DQNTrainConfig, ReplayBuffer)
 from .env_runner import EnvRunnerGroup, SingleAgentEnvRunner  # noqa
+from .impala import (IMPALA, Aggregator, ImpalaJaxLearner,  # noqa
+                     IMPALAConfig, VTraceConfig)
 from .learner import LearnerGroup, PPOConfig, PPOJaxLearner  # noqa
 from .rl_module import JaxRLModule, RLModuleSpec  # noqa: F401

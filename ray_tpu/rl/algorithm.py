@@ -64,7 +64,7 @@ class PPO:
                                           config.num_learners)
         self.env_runner_group = EnvRunnerGroup(
             config.env_fn, spec, config.num_env_runners,
-            config.num_envs_per_runner)
+            config.num_envs_per_runner, gamma=config.ppo.gamma)
         self.iteration = 0
         self._weights = self.learner_group.get_weights()
         self.env_runner_group.set_weights(self._weights)

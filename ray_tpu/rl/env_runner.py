@@ -21,14 +21,20 @@ class SingleAgentEnvRunner:
     """Plain class; wrapped as an actor by EnvRunnerGroup."""
 
     def __init__(self, env_fn: Callable, module_spec, num_envs: int = 1,
-                 seed: int = 0):
+                 seed: int = 0, gamma: float = 0.99):
         import gymnasium as gym
 
         from .rl_module import JaxRLModule
 
+        # SAME_STEP autoreset: every recorded row is a REAL transition
+        # (NEXT_STEP mode would interleave one bogus ignored-action row
+        # per episode); the pre-reset terminal observation arrives in
+        # info["final_obs"] for time-limit bootstrapping.
         self.envs = gym.vector.SyncVectorEnv(
-            [lambda i=i: env_fn() for i in range(num_envs)])
+            [lambda i=i: env_fn() for i in range(num_envs)],
+            autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
         self.num_envs = num_envs
+        self._gamma = gamma
         self.module = JaxRLModule(module_spec)
         self.params = None
         self._seed = seed
@@ -58,8 +64,24 @@ class SingleAgentEnvRunner:
             self._rng_key, sub = jax.random.split(self._rng_key)
             action, logp, value = self._fwd(self.params, self._obs, sub)
             action = np.asarray(action)
-            next_obs, reward, term, trunc, _ = self.envs.step(action)
+            next_obs, reward, term, trunc, info = self.envs.step(action)
             done = np.logical_or(term, trunc)
+            reward = np.asarray(reward, np.float32)
+            if trunc.any():
+                # Time-limit truncation is NOT termination: fold the
+                # bootstrap value of the pre-reset observation into the
+                # reward (r' = r + gamma*V(final_obs)), then cut the
+                # recursion like a terminal — unbiased targets without
+                # leaking across the episode boundary.
+                fo = info.get("final_obs")
+                if fo is not None:
+                    idx = np.nonzero(trunc)[0]
+                    fobs = np.stack([np.asarray(fo[i], np.float32)
+                                     for i in idx])
+                    _, _, v_boot = self._fwd(
+                        self.params, fobs, jax.random.PRNGKey(0))
+                    reward = reward.copy()
+                    reward[idx] += self._gamma * np.asarray(v_boot)
             obs_b.append(self._obs)
             act_b.append(action)
             rew_b.append(reward)
@@ -84,6 +106,7 @@ class SingleAgentEnvRunner:
             "logp": np.stack(logp_b).astype(np.float32),
             "values": np.stack(val_b).astype(np.float32),
             "last_values": np.asarray(last_value, np.float32),  # [N]
+            "last_obs": np.asarray(self._obs, np.float32),      # [N, D]
         }
 
     def episode_stats(self, window: int = 100) -> Dict[str, float]:
@@ -96,35 +119,61 @@ class SingleAgentEnvRunner:
 
 
 class EnvRunnerGroup:
-    """N runner actors with weight broadcast + parallel sampling (ref:
-    env_runner_group.py foreach_env_runner)."""
+    """N runner actors with weight broadcast + parallel sampling over a
+    fault-tolerant fleet: a runner killed mid-iteration is absorbed (its
+    rollout is skipped) and restored with current weights before the
+    next one (ref: env_runner_group.py:71 built on
+    FaultTolerantActorManager, actor_manager.py:198)."""
 
     def __init__(self, env_fn: Callable, module_spec,
-                 num_runners: int = 1, num_envs_per_runner: int = 1):
+                 num_runners: int = 1, num_envs_per_runner: int = 1,
+                 gamma: float = 0.99):
         from ..core import serialization
+
+        from .actor_manager import FaultTolerantActorManager
 
         serialization.ensure_code_portable(env_fn)
         actor_cls = ray_tpu.remote(SingleAgentEnvRunner)
-        self.runners = [
-            actor_cls.remote(env_fn, module_spec, num_envs_per_runner,
-                             seed=1000 + 17 * i)
-            for i in range(num_runners)
-        ]
+        self._weights = None
+
+        def factory(i: int):
+            return actor_cls.remote(env_fn, module_spec,
+                                    num_envs_per_runner,
+                                    seed=1000 + 17 * i, gamma=gamma)
+
+        def on_restore(actor):
+            if self._weights is not None:
+                ray_tpu.get(actor.set_weights.remote(self._weights),
+                            timeout=120)
+
+        self._mgr = FaultTolerantActorManager(
+            factory, num_runners, on_restore=on_restore)
+
+    @property
+    def runners(self) -> List[Any]:
+        return self._mgr.actors
+
+    @property
+    def num_restarts(self) -> int:
+        return self._mgr.num_restarts
 
     def set_weights(self, params) -> None:
-        ray_tpu.get([r.set_weights.remote(params) for r in self.runners])
+        self._weights = params
+        self._mgr.foreach("set_weights", params)
+        self._mgr.restore_unhealthy()
 
     def sample(self, num_steps_per_runner: int) -> List[Dict]:
-        return ray_tpu.get([r.sample.remote(num_steps_per_runner)
-                            for r in self.runners])
+        results = self._mgr.foreach("sample", num_steps_per_runner)
+        rollouts = [r.value for r in results if r.ok]
+        self._mgr.restore_unhealthy()  # on_restore re-arms weights
+        if not rollouts:
+            raise RuntimeError(
+                "every env runner failed this iteration")
+        return rollouts
 
-    def stats(self) -> List[Dict]:
-        return ray_tpu.get([r.episode_stats.remote()
-                            for r in self.runners])
+    def stats(self, window: int = 100) -> List[Dict]:
+        return [r.value for r in
+                self._mgr.foreach("episode_stats", window) if r.ok]
 
     def shutdown(self) -> None:
-        for r in self.runners:
-            try:
-                ray_tpu.kill(r)
-            except Exception:
-                pass
+        self._mgr.shutdown()

@@ -231,6 +231,7 @@ def _merge_rollouts(rollouts: List[Dict]) -> Dict[str, np.ndarray]:
         return rollouts[0]
     out = {}
     for k in rollouts[0]:
-        axis = 0 if k == "last_values" else 1  # concat over env axis
+        # [N]-shaped bootstrap entries concat on axis 0; [T, N] on 1.
+        axis = 0 if k in ("last_values", "last_obs") else 1
         out[k] = np.concatenate([r[k] for r in rollouts], axis=axis)
     return out

@@ -1,0 +1,167 @@
+"""IMPALA: V-trace math, multi-learner CartPole learning, and elastic
+env-runner fleets absorbing a kill mid-training.
+
+Ref: rllib/algorithms/impala/impala.py:136,150 + utils/actor_manager.py
+:198 — VERDICT round-1 item 7.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import (IMPALAConfig, ImpalaJaxLearner, RLModuleSpec,
+                        VTraceConfig)
+
+
+def _fake_batch(rng, t=16, n=4, obs_dim=4, act_dim=2):
+    return {
+        "obs": rng.normal(size=(t, n, obs_dim)).astype(np.float32),
+        "actions": rng.integers(0, act_dim, size=(t, n)),
+        "rewards": rng.normal(size=(t, n)).astype(np.float32),
+        "dones": np.zeros((t, n), np.float32),
+        "logp": np.full((t, n), -0.693, np.float32),
+        "last_obs": rng.normal(size=(n, obs_dim)).astype(np.float32),
+    }
+
+
+def test_vtrace_reduces_to_nstep_returns_when_on_policy():
+    """With rho=c=1 (on-policy) V-trace targets equal discounted n-step
+    returns bootstrapped from last_value — checked against an
+    independent numpy recursion."""
+    from ray_tpu.rl.impala import vtrace_targets
+
+    rng = np.random.default_rng(0)
+    t, n = 7, 3
+    values = rng.normal(size=(t, n)).astype(np.float32)
+    last_value = rng.normal(size=n).astype(np.float32)
+    rewards = rng.normal(size=(t, n)).astype(np.float32)
+    dones = (rng.random((t, n)) < 0.2).astype(np.float32)
+    gamma = 0.9
+    discounts = (gamma * (1 - dones)).astype(np.float32)
+    rhos = np.ones((t, n), np.float32)
+
+    vs, pg_adv = vtrace_targets(values, last_value, rewards, discounts,
+                                rhos)
+    # numpy reference: vs_t = r_t + disc_t * vs_{t+1}; vs_T -> last.
+    ref = np.zeros((t, n), np.float32)
+    nxt = last_value
+    for i in range(t - 1, -1, -1):
+        ref[i] = rewards[i] + discounts[i] * nxt
+        nxt = ref[i]
+    np.testing.assert_allclose(np.asarray(vs), ref, rtol=1e-4,
+                               atol=1e-4)
+    # pg advantage at on-policy: r + disc*vs_next - v.
+    vs_next = np.concatenate([ref[1:], last_value[None]], axis=0)
+    np.testing.assert_allclose(
+        np.asarray(pg_adv), rewards + discounts * vs_next - values,
+        rtol=1e-4, atol=1e-4)
+
+    # Off-policy: rho clipping caps the correction weight.
+    big_rhos = np.full((t, n), 7.0, np.float32)
+    vs2, pg2 = vtrace_targets(values, last_value, rewards, discounts,
+                              big_rhos, rho_clip=1.0, c_clip=1.0)
+    np.testing.assert_allclose(np.asarray(vs2), ref, rtol=1e-4,
+                               atol=1e-4)  # clipped back to 1
+
+
+def test_impala_learner_smoke():
+    learner = ImpalaJaxLearner(RLModuleSpec(4, 2, (8,)),
+                               VTraceConfig(gamma=0.9))
+    rng = np.random.default_rng(0)
+    m1 = learner.update_from_batch(_fake_batch(rng, t=8, n=2))
+    assert np.isfinite(m1["loss"])
+    assert 0 < m1["mean_rho"] < 100
+
+
+def test_impala_learner_value_fits():
+    learner = ImpalaJaxLearner(RLModuleSpec(4, 2, (16,)),
+                               VTraceConfig(lr=1e-2))
+    rng = np.random.default_rng(1)
+    batch = _fake_batch(rng, t=32, n=4)
+    losses = [learner.update_from_batch(batch)["vf_loss"]
+              for _ in range(12)]
+    assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.slow
+def test_impala_cartpole_two_learners_with_runner_kill():
+    rt = ray_tpu.init(mode="cluster", num_cpus=8)
+    try:
+        def make_env():
+            import gymnasium as gym
+
+            return gym.make("CartPole-v1")
+
+        algo = (IMPALAConfig()
+                .environment(make_env, observation_dim=4, action_dim=2)
+                .env_runners(num_env_runners=2, num_envs_per_runner=4,
+                             rollout_length=64)
+                .learners(num_learners=2)
+                .training(lr=5e-3, entropy_coeff=0.005))
+        import dataclasses
+
+        algo = dataclasses.replace(algo, broadcast_interval=1).build()
+        returns = []
+        for i in range(40):
+            res = algo.train()
+            returns.append(res["episode_return_mean"])
+            if i == 4:
+                # Chaos: kill one env runner mid-training; the fleet
+                # must absorb it and keep iterating.
+                ray_tpu.kill(algo.env_runner_group.runners[0])
+        assert res["num_env_runner_restarts"] >= 1, res
+        algo.stop()
+        assert max(returns[10:]) > 50, returns
+        assert max(returns) > 2.0 * max(returns[0], 10), returns
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_dqn_learner_td_decreases():
+    from ray_tpu.rl import DQNJaxLearner, DQNTrainConfig
+
+    learner = DQNJaxLearner(RLModuleSpec(4, 2, (32,)),
+                            DQNTrainConfig(lr=5e-3))
+    rng = np.random.default_rng(3)
+    obs = rng.normal(size=(256, 4)).astype(np.float32)
+    actions = rng.integers(0, 2, 256).astype(np.int32)
+    batch = {
+        "obs": obs,
+        "actions": actions,
+        # Deterministic reward: learnable exactly (terminal steps make
+        # the update pure regression, so TD error must shrink).
+        "rewards": (obs[:, 0] * (2 * actions - 1)).astype(np.float32),
+        "dones": np.ones(256, np.float32),  # pure regression to rewards
+        "next_obs": rng.normal(size=(256, 4)).astype(np.float32),
+    }
+    tds = [learner.update_from_batch(batch)["td_abs"]
+           for _ in range(30)]
+    assert tds[-1] < tds[0] * 0.8, (tds[0], tds[-1])
+
+
+@pytest.mark.slow
+def test_dqn_cartpole_improves():
+    from ray_tpu.rl import DQNConfig
+
+    rt = ray_tpu.init(mode="cluster", num_cpus=4)
+    try:
+        def make_env():
+            import gymnasium as gym
+
+            return gym.make("CartPole-v1")
+
+        algo = (DQNConfig()
+                .environment(make_env, observation_dim=4, action_dim=2)
+                .env_runners(num_env_runners=1, num_envs_per_runner=8,
+                             rollout_length=64)
+                .training(learning_starts=512, updates_per_iteration=64,
+                          epsilon_decay_steps=6000, lr=1e-3,
+                          target_sync_every=100)
+                .build())
+        returns = []
+        for _ in range(25):
+            returns.append(algo.train()["episode_return_mean"])
+        algo.stop()
+        assert max(returns[10:]) > 60, returns
+    finally:
+        ray_tpu.shutdown()
