@@ -963,11 +963,18 @@ class ClusterRuntime(BaseRuntime):
     def _fetch_store_value(self, oid: ObjectID,
                            timeout: Optional[float]) -> Any:
         """Pull a plane object into the local node store and map it,
-        reconstructing from lineage if every copy was lost."""
-        r = self.io.run(self._pull_with_recovery(oid, timeout))
-        if not r.get("ok"):
-            raise ObjectLostError(oid.hex())
-        return self.store.get(oid, r["size"])
+        reconstructing from lineage if every copy was lost.  The map can
+        race a spill/eviction in the window after the pull reply — a
+        missing segment means re-pull (which restores), not data loss."""
+        for _ in range(3):
+            r = self.io.run(self._pull_with_recovery(oid, timeout))
+            if not r.get("ok"):
+                raise ObjectLostError(oid.hex())
+            try:
+                return self.store.get(oid, r["size"])
+            except FileNotFoundError:
+                continue
+        raise ObjectLostError(oid.hex())
 
     async def _pull_with_recovery(self, oid: ObjectID,
                                   timeout: Optional[float]) -> Dict:

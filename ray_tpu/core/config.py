@@ -96,6 +96,12 @@ define_flag("metrics_report_period_s", float, 5.0,
 define_flag("task_event_buffer_size", int, 10000,
             "Max buffered per-task lifecycle events before drop-oldest.")
 define_flag("tracing_enabled", bool, False, "Emit task/actor spans.")
+define_flag("object_transfer_chunk_bytes", int, 4 * 1024**2,
+            "Node-to-node object transfer chunk size; larger objects "
+            "move as a sequence of chunk RPCs, not one giant frame.")
+define_flag("object_spill_enabled", bool, True,
+            "Spill pinned objects to disk under store pressure instead "
+            "of running over capacity.")
 define_flag("autoscaling_enabled", bool, False,
             "Hold cluster-infeasible lease requests (reported as demand "
             "for the autoscaler to satisfy) instead of failing fast.")

@@ -103,8 +103,14 @@ class NodeAgent:
         self.free_chips: List[int] = list(range(n_chips))
         self.server = RpcServer()
         self.store = SharedObjectStore(session)
+        spill_dir = None
+        if config.object_spill_enabled:
+            spill_dir = os.path.join(
+                config.session_dir_root, session, "spill",
+                self.node_id.hex()[:8])
         self.directory = StoreDirectory(
-            self.store, config.object_store_memory_bytes)
+            self.store, config.object_store_memory_bytes,
+            spill_dir=spill_dir)
         self.workers: Dict[WorkerID, WorkerEntry] = {}
         self.leases: Dict[int, Lease] = {}
         self.bundles: Dict[Tuple[PlacementGroupID, int], _Bundle] = {}
@@ -126,7 +132,8 @@ class NodeAgent:
             "register_worker", "worker_heartbeat",
             "report_task_events", "report_metrics",
             "task_blocked", "task_unblocked",
-            "register_object", "pull_object", "fetch_raw", "delete_object",
+            "register_object", "pull_object", "fetch_raw", "fetch_chunk",
+            "delete_object",
             "object_exists", "store_stats",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "restart_actor", "kill_worker", "report_actor_failure",
@@ -786,22 +793,39 @@ class NodeAgent:
         oid = p["object_id"]
         ent = self.directory.lookup(oid)
         if ent is not None:
+            if ent.spilled:
+                # Bring it back into shm so the caller can map it (ref:
+                # local_object_manager restore-from-spill).
+                loop = asyncio.get_event_loop()
+                ok = await loop.run_in_executor(
+                    None, self.directory.restore, oid)
+                if not ok:
+                    return {"ok": False, "error": "spilled copy lost"}
+            self._grant_read_window(oid)
             return {"ok": True, "size": ent.size}
         if p.get("fail_fast"):
             # Recovery probes never coalesce: they must answer "gone"
             # immediately, not wait behind a long-polling pull (and a
             # normal pull must not inherit a probe's instant failure).
-            return await self._do_pull(oid, p.get("timeout", 30.0),
-                                       fail_fast=True)
+            r = await self._do_pull(oid, p.get("timeout", 30.0),
+                                    fail_fast=True)
+            if r.get("ok"):
+                self._grant_read_window(oid)
+            return r
         inflight = self._pull_inflight.get(oid)
         if inflight is not None:
-            return await asyncio.shield(inflight)
+            result = await asyncio.shield(inflight)
+            if result.get("ok"):
+                self._grant_read_window(oid)
+            return result
         fut = asyncio.get_event_loop().create_future()
         self._pull_inflight[oid] = fut
         try:
             result = await self._do_pull(oid, p.get("timeout", 30.0))
             if not fut.done():
                 fut.set_result(result)
+            if result.get("ok"):
+                self._grant_read_window(oid)
             return result
         except Exception as e:
             if not fut.done():
@@ -836,30 +860,45 @@ class NodeAgent:
                         except RpcError:
                             continue
                         self._peer_agents[addr] = cli
+                    size_hint = loc.get("size", 0)
+                    chunk = self.config.object_transfer_chunk_bytes
                     try:
-                        data = await cli.call("fetch_raw",
-                                              {"object_id": oid})
+                        if size_hint and size_hint > chunk:
+                            n = await self._pull_chunked(
+                                cli, oid, size_hint, chunk)
+                        else:
+                            data = await cli.call("fetch_raw",
+                                                  {"object_id": oid})
+                            if data is None:
+                                continue
+                            self.store.put_raw(oid, data)
+                            n = len(data)
                     except RpcError:
                         continue
-                    if data is None:
+                    if n is None:
                         continue
-                    self.store.put_raw(oid, data)
                     # Pulled replica = secondary copy, LRU-evictable.
-                    evicted = self.directory.register(oid, len(data))
+                    evicted = self.directory.register(oid, n)
                     try:
                         await self._ctl.call("publish_locations", {
                             "node_id": self.node_id,
-                            "objects": [(oid, len(data))]})
+                            "objects": [(oid, n)]})
                         if evicted:
                             await self._ctl.call("remove_locations", {
                                 "node_id": self.node_id,
                                 "objects": evicted})
                     except RpcError:
                         pass
-                    return {"ok": True, "size": len(data)}
+                    return {"ok": True, "size": n}
             # Re-check local (producer may have just sealed here).
             ent = self.directory.lookup(oid)
             if ent is not None:
+                if ent.spilled:
+                    ok = await asyncio.get_event_loop().run_in_executor(
+                        None, self.directory.restore, oid)
+                    if not ok:
+                        return {"ok": False,
+                                "error": "spilled copy lost"}
                 return {"ok": True, "size": ent.size}
             if fail_fast and not (loc and loc["nodes"]):
                 return {"ok": False, "error": "no locations"}
@@ -868,26 +907,109 @@ class NodeAgent:
             await asyncio.sleep(delay)
             delay = min(delay * 1.5, 0.5)
 
+    def _grant_read_window(self, oid: ObjectID,
+                           ttl: float = 10.0) -> None:
+        """Short transient read pin after a successful pull: the caller
+        maps the segment out-of-band, and under heavy spill churn the
+        object must not be re-spilled in that window (otherwise
+        concurrent readers thrash restore/spill and starve).  Windows
+        allow transient over-capacity; expiry sheds the excess."""
+        self.directory.read_pin(oid)
+        loop = asyncio.get_event_loop()
+
+        def _expire():
+            self.directory.read_unpin(oid)
+            n, used, cap = self.directory.stats()
+            if used > cap:
+                loop.run_in_executor(
+                    None, self.directory._shed_pressure, None)
+
+        loop.call_later(ttl, _expire)
+
+    async def _pull_chunked(self, cli, oid: ObjectID, size: int,
+                            chunk: int):
+        """Assemble a large object from bounded chunk RPCs, then seal it
+        locally (ref: pull_manager.h:52 chunked object reads — chunking
+        bounds the per-RPC frame, so no giant pickle frame ever crosses
+        the wire).  Assembly happens in a host buffer, NOT directly in
+        the destination segment: on a shared-/dev/shm test topology the
+        destination name aliases the source segment, and an in-place
+        create would clobber the bytes mid-read.  Returns the byte
+        count, or None if the source lost its copy."""
+        buf = bytearray(size)
+        offset = 0
+        while offset < size:
+            length = min(chunk, size - offset)
+            r = await cli.call("fetch_chunk", {
+                "object_id": oid, "offset": offset, "length": length})
+            if r is None:
+                return None
+            data = r["data"]
+            buf[offset:offset + len(data)] = data
+            offset += len(data)
+            if len(data) < length:
+                return None  # source shrank?! treat as lost
+        self.store.put_raw(oid, bytes(buf))
+        return size
+
     async def fetch_raw(self, p):
         oid = p["object_id"]
         ent = self.directory.lookup(oid)
         if ent is None:
             return None
-        # Transient pin: the peer's pull must not race local eviction.
-        self.directory.pin(oid)
+        # Transient read pin: the peer's pull must not race local
+        # eviction OR spilling.  Disk/shm copies run off the loop.
+        self.directory.read_pin(oid)
         try:
-            return self.store.read_raw(oid, ent.size)
+            loop = asyncio.get_event_loop()
+            if ent.spilled:
+                # Serve straight from disk; no need to un-spill locally.
+                return await loop.run_in_executor(
+                    None, self.directory.read_spilled, oid)
+            return await loop.run_in_executor(
+                None, self.store.read_raw, oid, ent.size)
         except FileNotFoundError:
             return None
         finally:
-            self.directory.unpin(oid)
+            self.directory.read_unpin(oid)
+
+    async def fetch_chunk(self, p):
+        """One chunk of an object's packed bytes (ref: pull_manager.h:52
+        chunked pulls / ObjectBufferPool) — large objects move as a
+        sequence of bounded frames, not one giant one.  Returns
+        {"data", "size"} or None if the copy vanished (the puller falls
+        back to another location)."""
+        oid = p["object_id"]
+        ent = self.directory.lookup(oid)
+        if ent is None:
+            return None
+        offset, length = p["offset"], p["length"]
+        self.directory.read_pin(oid)
+        try:
+            loop = asyncio.get_event_loop()
+            if ent.spilled:
+                data = await loop.run_in_executor(
+                    None, self.directory.read_spilled, oid, offset,
+                    length)
+                if data is None:
+                    return None
+            else:
+                data = await loop.run_in_executor(
+                    None, self.store.read_raw_slice, oid, offset,
+                    length)
+            return {"data": data, "size": ent.size}
+        except FileNotFoundError:
+            return None
+        finally:
+            self.directory.read_unpin(oid)
 
     async def delete_object(self, p):
         self.directory.delete(p["object_id"])
 
     async def store_stats(self, _p):
         n, used, cap = self.directory.stats()
-        return {"objects": n, "used_bytes": used, "capacity_bytes": cap}
+        return {"objects": n, "used_bytes": used, "capacity_bytes": cap,
+                **self.directory.spill_stats()}
 
     # -------------------------------------------------- placement bundles
     async def prepare_bundle(self, p):

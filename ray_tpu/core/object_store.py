@@ -54,6 +54,7 @@ class StoredObject:
     object_id: ObjectID
     size: int
     create_time: float
+    spilled: bool = False   # bytes live on disk, not in shm
 
 
 class SharedObjectStore:
@@ -150,6 +151,19 @@ class SharedObjectStore:
         finally:
             seg.close()
 
+    def read_raw_slice(self, oid: ObjectID, offset: int,
+                       length: int) -> bytes:
+        """One chunk of the packed bytes (chunked transfer send path,
+        ref: push_manager/ObjectBufferPool chunk reads)."""
+        seg = shared_memory.SharedMemory(
+            name=_segment_name(self._session, oid))
+        _untrack(seg.name)
+        try:
+            return bytes(seg.buf[offset:offset + length])
+        finally:
+            seg.close()
+
+
     def contains(self, oid: ObjectID) -> bool:
         try:
             seg = shared_memory.SharedMemory(
@@ -213,20 +227,32 @@ class StoreDirectory:
     primary copy doesn't unpin its lifetime pin.
     """
 
-    def __init__(self, store: SharedObjectStore, capacity_bytes: int):
+    def __init__(self, store: SharedObjectStore, capacity_bytes: int,
+                 spill_dir: Optional[str] = None):
         self._store = store
         self._capacity = capacity_bytes
+        self._spill_dir = spill_dir
         self._entries: "OrderedDict[ObjectID, StoredObject]" = OrderedDict()
-        self._pins: Dict[ObjectID, int] = {}
+        self._pins: Dict[ObjectID, int] = {}       # lifetime (primary)
+        self._read_pins: Dict[ObjectID, int] = {}  # transient read guards
+        self._restoring: Dict[ObjectID, threading.Event] = {}
         self._used = 0
+        self._spilled_bytes = 0
+        self._spill_count = 0
+        self._restore_count = 0
         self._lock = threading.Lock()
+
+    def _spill_path(self, oid: ObjectID) -> str:
+        return os.path.join(self._spill_dir, f"{oid.hex()}.bin")
 
     def register(self, oid: ObjectID, size: int,
                  primary: bool = False) -> List[ObjectID]:
         """Record a sealed object; returns ids evicted to make room.
         ``primary=True`` pins the copy for its lifetime (never evicted;
-        only delete() removes it)."""
-        evicted: List[ObjectID] = []
+        only delete() removes it).  Under pressure, unpinned secondary
+        copies are LRU-evicted (a copy exists elsewhere); pinned
+        primaries are SPILLED to disk instead of running the store over
+        capacity (ref: local_object_manager.h:110 SpillObjects)."""
         with self._lock:
             if oid in self._entries:
                 if primary:
@@ -237,20 +263,122 @@ class StoreDirectory:
             if primary:
                 self._pins[oid] = self._pins.get(oid, 0) + 1
             self._used += size
+        return self._shed_pressure(protect=oid)
+
+    def _shed_pressure(self, protect: Optional[ObjectID]) -> List[ObjectID]:
+        """Evict unpinned secondaries, then spill pinned primaries,
+        until under capacity.  Victims are claimed under the lock; the
+        spill IO runs outside it.  Entries with transient read pins are
+        never touched (a peer or restore is mid-read)."""
+        evicted: List[ObjectID] = []
+        to_spill: List[StoredObject] = []
+        with self._lock:
             while self._used > self._capacity:
                 victim = None
-                for vid in self._entries:
-                    if vid != oid and self._pins.get(vid, 0) == 0:
+                for vid, ent in self._entries.items():
+                    if vid != protect and not ent.spilled \
+                            and self._pins.get(vid, 0) == 0 \
+                            and self._read_pins.get(vid, 0) == 0 \
+                            and vid not in self._restoring:
                         victim = vid
                         break
-                if victim is None:
-                    break  # everything live is pinned; run over capacity
-                ent = self._entries.pop(victim)
-                self._used -= ent.size
-                evicted.append(victim)
+                if victim is not None:
+                    ent = self._entries.pop(victim)
+                    self._used -= ent.size
+                    evicted.append(victim)
+                    continue
+                if self._spill_dir is None:
+                    break  # no spill support; run over capacity
+                spill_victim = None
+                for vid, ent in self._entries.items():
+                    if vid != protect and not ent.spilled \
+                            and self._read_pins.get(vid, 0) == 0 \
+                            and vid not in self._restoring:
+                        spill_victim = ent
+                        break
+                if spill_victim is None:
+                    break  # everything else is mid-read; over capacity
+                spill_victim.spilled = True  # claimed under the lock
+                self._used -= spill_victim.size
+                self._spilled_bytes += spill_victim.size
+                self._spill_count += 1
+                to_spill.append(spill_victim)
         for vid in evicted:
             self._store.delete(vid)
+        for ent in to_spill:
+            self._write_spill(ent)
         return evicted
+
+    def _write_spill(self, ent: StoredObject) -> None:
+        os.makedirs(self._spill_dir, exist_ok=True)
+        data = self._store.read_raw(ent.object_id, ent.size)
+        tmp = self._spill_path(ent.object_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self._spill_path(ent.object_id))
+        self._store.delete(ent.object_id)
+
+    def restore(self, oid: ObjectID) -> bool:
+        """Bring a spilled object back into shm (ref:
+        local_object_manager.h:118 restore path).  Concurrent restores
+        of one object coalesce on a claim event — exactly one does the
+        IO and flips the accounting; losers wait and re-check."""
+        while True:
+            with self._lock:
+                ent = self._entries.get(oid)
+                if ent is None:
+                    return False
+                if not ent.spilled:
+                    return True
+                ev = self._restoring.get(oid)
+                if ev is None:
+                    ev = self._restoring[oid] = threading.Event()
+                    break  # we own the restore
+            ev.wait(timeout=300)
+            # Loop: re-check outcome (restored / deleted / re-spilled).
+        try:
+            try:
+                with open(self._spill_path(oid), "rb") as f:
+                    data = f.read()
+            except OSError:
+                with self._lock:
+                    ent = self._entries.get(oid)
+                    return ent is not None and not ent.spilled
+            self._store.put_raw(oid, data)
+            with self._lock:
+                ent = self._entries.get(oid)
+                if ent is None:
+                    self._store.delete(oid)  # freed while restoring
+                    return False
+                if ent.spilled:
+                    ent.spilled = False
+                    self._used += ent.size
+                    self._spilled_bytes -= ent.size
+                    self._restore_count += 1
+            try:
+                os.remove(self._spill_path(oid))
+            except OSError:
+                pass
+        finally:
+            with self._lock:
+                ev2 = self._restoring.pop(oid, None)
+            if ev2 is not None:
+                ev2.set()
+        # Restores grow _used: shed pressure so the store doesn't creep
+        # arbitrarily over capacity under a burst of gets.
+        self._shed_pressure(protect=oid)
+        return True
+
+    def read_spilled(self, oid: ObjectID, offset: int = 0,
+                     length: Optional[int] = None) -> Optional[bytes]:
+        """Serve spilled bytes straight from disk (remote pulls don't
+        need the object back in shm)."""
+        try:
+            with open(self._spill_path(oid), "rb") as f:
+                f.seek(offset)
+                return f.read(length if length is not None else -1)
+        except OSError:
+            return None
 
     def lookup(self, oid: ObjectID) -> Optional[StoredObject]:
         with self._lock:
@@ -271,20 +399,50 @@ class StoreDirectory:
             else:
                 self._pins[oid] = n
 
+    def read_pin(self, oid: ObjectID) -> None:
+        """Transient guard around a read: blocks eviction AND spilling
+        (a lifetime pin only blocks eviction)."""
+        with self._lock:
+            self._read_pins[oid] = self._read_pins.get(oid, 0) + 1
+
+    def read_unpin(self, oid: ObjectID) -> None:
+        with self._lock:
+            n = self._read_pins.get(oid, 0) - 1
+            if n <= 0:
+                self._read_pins.pop(oid, None)
+            else:
+                self._read_pins[oid] = n
+
     def delete(self, oid: ObjectID) -> bool:
         with self._lock:
             ent = self._entries.pop(oid, None)
             self._pins.pop(oid, None)
+            self._read_pins.pop(oid, None)
             if ent is not None:
-                self._used -= ent.size
+                if ent.spilled:
+                    self._spilled_bytes -= ent.size
+                else:
+                    self._used -= ent.size
         if ent is not None:
-            self._store.delete(oid)
+            if ent.spilled:
+                try:
+                    os.remove(self._spill_path(oid))
+                except OSError:
+                    pass
+            else:
+                self._store.delete(oid)
             return True
         return False
 
     def stats(self) -> Tuple[int, int, int]:
         with self._lock:
             return len(self._entries), self._used, self._capacity
+
+    def spill_stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"spilled_bytes": self._spilled_bytes,
+                    "spill_count": self._spill_count,
+                    "restore_count": self._restore_count}
 
     def all_ids(self) -> List[ObjectID]:
         with self._lock:

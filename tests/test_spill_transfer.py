@@ -1,0 +1,102 @@
+"""Object spilling under store pressure + chunked node-to-node
+transfer.
+
+Ref: src/ray/raylet/local_object_manager.h:110 (spill/restore),
+pull_manager.h:52 (chunked pulls) — VERDICT round-1 missing item 7.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def _agent_stats(rt):
+    return rt.agent_call("store_stats")
+
+
+def test_spill_and_restore_under_pressure():
+    """Live (pinned primary) objects exceed capacity: the store spills
+    instead of dying, and get() restores correct bytes."""
+    rt = ray_tpu.init(mode="cluster", num_cpus=2,
+                      config={"object_store_memory_bytes": 24 * 1024**2})
+    try:
+        arrays = [np.full((1024, 1024), i, np.float64)  # 8 MB each
+                  for i in range(6)]                    # 48 MB total
+        refs = [ray_tpu.put(a) for a in arrays]
+        stats = _agent_stats(rt)
+        assert stats["spill_count"] >= 1, stats
+        assert stats["used_bytes"] <= stats["capacity_bytes"] * 1.4
+        # Every object still readable (restore path), newest-first so
+        # restores themselves create more pressure.
+        for i in reversed(range(6)):
+            got = ray_tpu.get(refs[i], timeout=60)
+            assert got[0, 0] == i and got.shape == (1024, 1024)
+        assert _agent_stats(rt)["restore_count"] >= 1
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_chunked_transfer_between_nodes():
+    """A large object moves between nodes as bounded chunks and arrives
+    intact."""
+    import os
+
+    os.environ["RT_OBJECT_TRANSFER_CHUNK_BYTES"] = str(512 * 1024)
+    cluster = None
+    try:
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 1})
+        cluster.add_node(num_cpus=1, resources={"other": 1})
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(num_cpus=1)
+        def produce():
+            rng = np.random.default_rng(42)
+            return rng.normal(size=(1024, 1536))  # ~12 MB -> ~24 chunks
+
+        @ray_tpu.remote(resources={"other": 1})
+        def consume(arr):
+            return float(arr.sum()), arr.shape
+
+        ref = produce.remote()
+        total, shape = ray_tpu.get(consume.remote(ref), timeout=180)
+        expect = np.random.default_rng(42).normal(size=(1024, 1536))
+        assert shape == (1024, 1536)
+        assert abs(total - float(expect.sum())) < 1e-6
+    finally:
+        os.environ.pop("RT_OBJECT_TRANSFER_CHUNK_BYTES", None)
+        ray_tpu.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
+
+
+def test_remote_pull_of_spilled_object():
+    """Node B pulls an object node A has spilled — served from disk."""
+    import os
+
+    os.environ["RT_OBJECT_STORE_MEMORY_BYTES"] = str(20 * 1024**2)
+    cluster = None
+    try:
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 2})
+        cluster.add_node(num_cpus=1, resources={"other": 1})
+        ray_tpu.init(address=cluster.address)
+
+        # Several live 8 MB objects on the head node force spilling.
+        arrays = [np.full((1024, 1024), i, np.float64) for i in range(4)]
+        refs = [ray_tpu.put(a) for a in arrays]
+
+        @ray_tpu.remote(resources={"other": 1})
+        def read_remote(a0, a3):
+            return float(a0[0, 0]), float(a3[0, 0])
+
+        v0, v3 = ray_tpu.get(read_remote.remote(refs[0], refs[3]),
+                             timeout=180)
+        assert (v0, v3) == (0.0, 3.0)
+    finally:
+        os.environ.pop("RT_OBJECT_STORE_MEMORY_BYTES", None)
+        ray_tpu.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
