@@ -161,6 +161,23 @@ class NodeAgent:
         except (NotImplementedError, RuntimeError):
             pass
         await self.server.start(port)
+        # Evictions from ANY shed site (read-window expiry, restore
+        # pressure, register) must drop their controller locations, or
+        # recovery probes poll dead copies until timeout.
+        self._loop = asyncio.get_event_loop()
+
+        def _on_evict(oids):
+            async def _publish():
+                try:
+                    await self._ctl.call("remove_locations", {
+                        "node_id": self.node_id, "objects": oids})
+                except RpcError:
+                    pass
+
+            self._loop.call_soon_threadsafe(
+                lambda: spawn_task(_publish()))
+
+        self.directory.on_evict = _on_evict
         self._ctl = RpcClient(self.controller_addr,
                               tag=f"agent-{self.node_id.hex()[:8]}",
                               connect_timeout=5.0)
@@ -793,16 +810,7 @@ class NodeAgent:
         oid = p["object_id"]
         ent = self.directory.lookup(oid)
         if ent is not None:
-            if ent.spilled:
-                # Bring it back into shm so the caller can map it (ref:
-                # local_object_manager restore-from-spill).
-                loop = asyncio.get_event_loop()
-                ok = await loop.run_in_executor(
-                    None, self.directory.restore, oid)
-                if not ok:
-                    return {"ok": False, "error": "spilled copy lost"}
-            self._grant_read_window(oid)
-            return {"ok": True, "size": ent.size}
+            return await self._local_ready(oid, ent)
         if p.get("fail_fast"):
             # Recovery probes never coalesce: they must answer "gone"
             # immediately, not wait behind a long-polling pull (and a
@@ -893,19 +901,24 @@ class NodeAgent:
             # Re-check local (producer may have just sealed here).
             ent = self.directory.lookup(oid)
             if ent is not None:
-                if ent.spilled:
-                    ok = await asyncio.get_event_loop().run_in_executor(
-                        None, self.directory.restore, oid)
-                    if not ok:
-                        return {"ok": False,
-                                "error": "spilled copy lost"}
-                return {"ok": True, "size": ent.size}
+                return await self._local_ready(oid, ent)
             if fail_fast and not (loc and loc["nodes"]):
                 return {"ok": False, "error": "no locations"}
             if asyncio.get_event_loop().time() > deadline:
                 return {"ok": False, "error": "object not found"}
             await asyncio.sleep(delay)
             delay = min(delay * 1.5, 0.5)
+
+    async def _local_ready(self, oid: ObjectID, ent) -> Dict:
+        """Finalize a pull that found a local entry: restore from spill
+        if needed, grant the read window, build the reply."""
+        if ent.spilled:
+            ok = await asyncio.get_event_loop().run_in_executor(
+                None, self.directory.restore, oid)
+            if not ok:
+                return {"ok": False, "error": "spilled copy lost"}
+        self._grant_read_window(oid)
+        return {"ok": True, "size": ent.size}
 
     def _grant_read_window(self, oid: ObjectID,
                            ttl: float = 10.0) -> None:
@@ -949,7 +962,7 @@ class NodeAgent:
             offset += len(data)
             if len(data) < length:
                 return None  # source shrank?! treat as lost
-        self.store.put_raw(oid, bytes(buf))
+        self.store.put_raw(oid, memoryview(buf))
         return size
 
     async def fetch_raw(self, p):

@@ -235,7 +235,12 @@ class StoreDirectory:
         self._entries: "OrderedDict[ObjectID, StoredObject]" = OrderedDict()
         self._pins: Dict[ObjectID, int] = {}       # lifetime (primary)
         self._read_pins: Dict[ObjectID, int] = {}  # transient read guards
-        self._restoring: Dict[ObjectID, threading.Event] = {}
+        # One spill OR restore in flight per object: the claim holder
+        # owns the IO; everyone else waits on the event and re-checks.
+        self._io_events: Dict[ObjectID, threading.Event] = {}
+        # Agent hook: called (from any thread) with ids whose local copy
+        # vanished, so stale locations leave the control plane.
+        self.on_evict = None
         self._used = 0
         self._spilled_bytes = 0
         self._spill_count = 0
@@ -267,9 +272,11 @@ class StoreDirectory:
 
     def _shed_pressure(self, protect: Optional[ObjectID]) -> List[ObjectID]:
         """Evict unpinned secondaries, then spill pinned primaries,
-        until under capacity.  Victims are claimed under the lock; the
-        spill IO runs outside it.  Entries with transient read pins are
-        never touched (a peer or restore is mid-read)."""
+        until under capacity.  Victims (and their per-object IO claim)
+        are taken under the lock; the spill IO runs outside it.  Entries
+        with transient read pins or an active IO claim are never
+        touched.  Evicted ids also flow to ``on_evict`` so the control
+        plane drops their locations."""
         evicted: List[ObjectID] = []
         to_spill: List[StoredObject] = []
         with self._lock:
@@ -279,7 +286,7 @@ class StoreDirectory:
                     if vid != protect and not ent.spilled \
                             and self._pins.get(vid, 0) == 0 \
                             and self._read_pins.get(vid, 0) == 0 \
-                            and vid not in self._restoring:
+                            and vid not in self._io_events:
                         victim = vid
                         break
                 if victim is not None:
@@ -293,46 +300,80 @@ class StoreDirectory:
                 for vid, ent in self._entries.items():
                     if vid != protect and not ent.spilled \
                             and self._read_pins.get(vid, 0) == 0 \
-                            and vid not in self._restoring:
+                            and vid not in self._io_events:
                         spill_victim = ent
                         break
                 if spill_victim is None:
                     break  # everything else is mid-read; over capacity
+                vid = spill_victim.object_id
                 spill_victim.spilled = True  # claimed under the lock
+                self._io_events[vid] = threading.Event()
                 self._used -= spill_victim.size
                 self._spilled_bytes += spill_victim.size
                 self._spill_count += 1
                 to_spill.append(spill_victim)
         for vid in evicted:
             self._store.delete(vid)
+        if evicted and self.on_evict is not None:
+            try:
+                self.on_evict(list(evicted))
+            except Exception:
+                pass
         for ent in to_spill:
             self._write_spill(ent)
         return evicted
 
     def _write_spill(self, ent: StoredObject) -> None:
-        os.makedirs(self._spill_dir, exist_ok=True)
-        data = self._store.read_raw(ent.object_id, ent.size)
-        tmp = self._spill_path(ent.object_id) + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, self._spill_path(ent.object_id))
-        self._store.delete(ent.object_id)
+        """Holds the IO claim taken in _shed_pressure.  On any failure
+        the accounting reverts and the shm copy stays authoritative —
+        a spill must never strand bytes that are still present."""
+        oid = ent.object_id
+        tmp = self._spill_path(oid) + ".tmp"
+        try:
+            os.makedirs(self._spill_dir, exist_ok=True)
+            data = self._store.read_raw(oid, ent.size)
+            with open(tmp, "wb") as f:
+                f.write(data)
+            with self._lock:
+                if oid not in self._entries:
+                    # Deleted mid-spill: drop everything.
+                    os.remove(tmp)
+                    self._store.delete(oid)
+                    return
+                os.replace(tmp, self._spill_path(oid))
+            self._store.delete(oid)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            with self._lock:
+                if oid in self._entries and ent.spilled:
+                    ent.spilled = False
+                    self._used += ent.size
+                    self._spilled_bytes -= ent.size
+                    self._spill_count -= 1
+        finally:
+            with self._lock:
+                ev = self._io_events.pop(oid, None)
+            if ev is not None:
+                ev.set()
 
     def restore(self, oid: ObjectID) -> bool:
         """Bring a spilled object back into shm (ref:
-        local_object_manager.h:118 restore path).  Concurrent restores
-        of one object coalesce on a claim event — exactly one does the
-        IO and flips the accounting; losers wait and re-check."""
+        local_object_manager.h:118 restore path).  Spills and restores
+        of one object serialize on the per-object IO claim — exactly
+        one owner does IO; everyone else waits and re-checks."""
         while True:
             with self._lock:
                 ent = self._entries.get(oid)
                 if ent is None:
                     return False
-                if not ent.spilled:
+                if not ent.spilled and oid not in self._io_events:
                     return True
-                ev = self._restoring.get(oid)
+                ev = self._io_events.get(oid)
                 if ev is None:
-                    ev = self._restoring[oid] = threading.Event()
+                    ev = self._io_events[oid] = threading.Event()
                     break  # we own the restore
             ev.wait(timeout=300)
             # Loop: re-check outcome (restored / deleted / re-spilled).
@@ -361,7 +402,7 @@ class StoreDirectory:
                 pass
         finally:
             with self._lock:
-                ev2 = self._restoring.pop(oid, None)
+                ev2 = self._io_events.pop(oid, None)
             if ev2 is not None:
                 ev2.set()
         # Restores grow _used: shed pressure so the store doesn't creep

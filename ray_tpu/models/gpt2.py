@@ -43,6 +43,13 @@ class GPT2Config:
     remat: bool = True
     mesh: Any = None                  # jax Mesh for CP shard_map wrappers
     rules: Any = None                 # ShardingRules override
+    # Mixture-of-Experts: >0 turns every ``moe_every``-th block's MLP
+    # into an expert-parallel MoEMLP (ops/moe.py).
+    moe_num_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @staticmethod
     def small() -> "GPT2Config":
@@ -116,6 +123,7 @@ def _attention(cfg: GPT2Config, q, k, v):
 
 class Block(nn.Module):
     cfg: GPT2Config
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -139,6 +147,15 @@ class Block(nn.Module):
                            0.02 / (2 * cfg.n_layer) ** 0.5))(att)
         x = x + att
         y = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        if self.use_moe:
+            from ..ops.moe import MoEMLP
+
+            y = MoEMLP(d_model=cfg.d_model, d_ff=cfg.d_ff,
+                       num_experts=cfg.moe_num_experts,
+                       top_k=cfg.moe_top_k,
+                       capacity_factor=cfg.moe_capacity_factor,
+                       dtype=cfg.dtype, name="moe_mlp")(y)
+            return x + y
         y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="mlp_in",
                      kernel_init=nn.initializers.normal(0.02))(y)
         y = _constrain(y, ("batch", "seq", "mlp"), cfg)
@@ -166,7 +183,9 @@ class GPT2(nn.Module):
         if cfg.remat:
             block = nn.remat(Block, prevent_cse=False)
         for i in range(cfg.n_layer):
-            x = block(cfg, name=f"h_{i}")(x)
+            use_moe = (cfg.moe_num_experts > 0
+                       and i % cfg.moe_every == cfg.moe_every - 1)
+            x = block(cfg, use_moe=use_moe, name=f"h_{i}")(x)
             x = _constrain(x, ("batch", "seq", "embed"), cfg)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         if return_hidden:
@@ -213,27 +232,48 @@ def _chunked_xent(x, wte, targets, chunk: int) -> jnp.ndarray:
     return total / (b * t)
 
 
+def _moe_aux_total(inter) -> jnp.ndarray:
+    total = jnp.asarray(0.0, jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(inter):
+        total = total + jnp.sum(jnp.asarray(leaf, jnp.float32))
+    return total
+
+
 def gpt2_loss_fn(cfg: GPT2Config, params, batch,
                  loss_chunk: int = 128) -> jnp.ndarray:
-    """Next-token cross entropy; batch: {tokens [B, T+1] int32}."""
+    """Next-token cross entropy; batch: {tokens [B, T+1] int32}.
+    MoE configs add the sown Switch load-balancing auxiliary loss."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     t = inputs.shape[1]
+    moe = cfg.moe_num_experts > 0
     if loss_chunk and t % loss_chunk == 0 and t > loss_chunk \
-            and cfg.mesh is None:
+            and cfg.mesh is None and not moe:
         # Sharded runs keep the einsum whole so GSPMD can partition the
         # vocab dim; single-chip runs take the chunked low-HBM path.
         x = GPT2(cfg).apply(params, inputs, return_hidden=True)
         wte = params["params"]["wte"].astype(cfg.dtype)
         return _chunked_xent(x, wte, targets, loss_chunk)
-    logits = GPT2(cfg).apply(params, inputs)
+    if moe:
+        logits, state = GPT2(cfg).apply(params, inputs,
+                                        mutable=["intermediates"])
+        aux = _moe_aux_total(state.get("intermediates", {}))
+    else:
+        logits = GPT2(cfg).apply(params, inputs)
+        aux = 0.0
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return -jnp.mean(ll) + cfg.moe_aux_weight * aux
 
 
 def gpt2_param_axes(path: str, leaf) -> Tuple[Optional[str], ...]:
-    """Logical axes per parameter path for shard_pytree (DP/FSDP/TP)."""
+    """Logical axes per parameter path for shard_pytree
+    (DP/FSDP/TP/EP)."""
+    from ..ops.moe import moe_param_axes
+
+    moe = moe_param_axes(path, leaf)
+    if moe is not None:
+        return moe
     if "wte" in path:
         return ("vocab", "embed_fsdp")
     if "wpe" in path:

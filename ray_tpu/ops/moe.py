@@ -1,0 +1,108 @@
+"""Mixture-of-Experts feed-forward with expert parallelism.
+
+Fills SURVEY §2.3's EP row (absent from the reference, which delegates
+MoE to user frameworks).  TPU-first formulation (GShard/Switch style,
+public papers): routing is expressed as DENSE one-hot dispatch/combine
+einsums over an [experts, capacity] buffer — no ragged all-to-all
+primitive exists in XLA, and the dense-einsum form is exactly what GSPMD
+partitions well: with expert weights sharded over the ``expert`` mesh
+axis and tokens over ``data``, XLA lowers the dispatch/combine einsums
+to all-to-alls over ICI automatically.
+
+Components:
+- top-k router with fp32 gating, probability renormalization over the
+  chosen experts, and the Switch load-balancing auxiliary loss
+  (fraction-of-tokens x mean-gate per expert, scaled by E);
+- capacity enforcement (capacity_factor x tokens/experts): tokens over
+  an expert's capacity are dropped (their combine weight is zero, so
+  the residual stream passes them through unchanged);
+- batched expert FFNs as single [E, ...] einsums (one MXU-friendly
+  matmul per projection, not a Python loop over experts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for a transformer MLP block."""
+
+    d_model: int
+    d_ff: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, t, d = x.shape
+        e = self.num_experts
+        s = b * t
+        capacity = max(int(self.capacity_factor * s / e), self.top_k)
+        xf = x.reshape(s, d)
+
+        # ---- router (fp32: gating decisions must not flip in bf16)
+        router = self.param("router",
+                            nn.initializers.normal(0.02 / d ** 0.5),
+                            (d, e), jnp.float32)
+        logits = jnp.asarray(xf, jnp.float32) @ router          # [S, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(probs, self.top_k)  # [S, K]
+        # Renormalize over the selected experts.
+        gate_vals = gate_vals / (gate_vals.sum(-1, keepdims=True) + 1e-9)
+
+        # ---- Switch aux loss: E * sum_e f_e * P_e  (ref: the public
+        # Switch Transformer formulation) — sown for the trainer to add.
+        assign1 = jax.nn.one_hot(gate_idx[:, 0], e)             # top-1
+        f = assign1.mean(0)
+        p = probs.mean(0)
+        self.sow("intermediates", "moe_aux", e * jnp.sum(f * p))
+
+        # ---- capacity: position of each (token, k) within its expert.
+        onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)   # [S,K,E]
+        flatk = onehot.reshape(s * self.top_k, e)  # k-major per token
+        pos = jnp.cumsum(flatk, axis=0) - flatk                 # [SK, E]
+        pos = (pos * flatk).sum(-1).reshape(s, self.top_k)      # [S, K]
+        keep = pos < capacity
+        gate_vals = gate_vals * keep
+
+        # ---- dispatch/combine one-hots: [S, K, E, C]
+        pos_oh = jax.nn.one_hot(jnp.where(keep, pos, capacity),
+                                capacity, dtype=self.dtype)
+        disp = (jnp.asarray(onehot, self.dtype)[..., None]
+                * pos_oh[:, :, None, :])                        # [S,K,E,C]
+        dispatch = disp.sum(1)                                  # [S, E, C]
+        combine = (disp * jnp.asarray(gate_vals, self.dtype)
+                   [:, :, None, None]).sum(1)                   # [S, E, C]
+
+        # ---- expert FFNs, batched over E.
+        w_in = self.param("w_in", nn.initializers.normal(0.02),
+                          (e, d, self.d_ff), jnp.float32)
+        w_out = self.param("w_out", nn.initializers.normal(0.02),
+                           (e, self.d_ff, d), jnp.float32)
+        expert_in = jnp.einsum("sec,sd->ecd", dispatch,
+                               jnp.asarray(xf, self.dtype))     # [E,C,D]
+        h = jnp.einsum("ecd,edf->ecf", expert_in,
+                       jnp.asarray(w_in, self.dtype))
+        h = nn.gelu(h)
+        out = jnp.einsum("ecf,efd->ecd", h,
+                         jnp.asarray(w_out, self.dtype))        # [E,C,D]
+        y = jnp.einsum("sec,ecd->sd", combine, out)             # [S, D]
+        return y.reshape(b, t, d)
+
+
+def moe_param_axes(path: str, leaf) -> Optional[Tuple]:
+    """Logical axes for MoE params (None = not a MoE param)."""
+    if "router" in path:
+        return ("embed_fsdp", None)
+    if "w_in" in path:
+        return ("expert", "embed_fsdp", "mlp")
+    if "w_out" in path:
+        return ("expert", "mlp", "embed_fsdp")
+    return None

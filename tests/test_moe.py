@@ -1,0 +1,123 @@
+"""MoE layer: routing/capacity math, gradients, GPT-2 integration, and
+expert-parallel execution on the virtual mesh (SURVEY §2.3 EP row —
+VERDICT round-1 missing item 13)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models.gpt2 import (GPT2Config, gpt2_init, gpt2_loss_fn,
+                                 gpt2_param_axes)
+from ray_tpu.ops.moe import MoEMLP
+
+
+def _layer(e=4, k=2, cap=2.0, d=16, ff=32):
+    return MoEMLP(d_model=d, d_ff=ff, num_experts=e, top_k=k,
+                  capacity_factor=cap, dtype=jnp.float32)
+
+
+def test_moe_forward_shape_and_grads():
+    layer = _layer()
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    params = layer.init(jax.random.PRNGKey(1), x)
+
+    def loss(p):
+        y, state = layer.apply(p, x, mutable=["intermediates"])
+        aux = jax.tree_util.tree_leaves(state["intermediates"])[0]
+        return jnp.mean(y ** 2) + 0.01 * jnp.sum(aux)
+
+    val, grads = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(val))
+    flat = jax.tree_util.tree_leaves(grads)
+    assert all(np.all(np.isfinite(g)) for g in flat)
+    # Router AND experts both receive gradient.
+    g = grads["params"]
+    assert float(jnp.abs(g["router"]).sum()) > 0
+    assert float(jnp.abs(g["w_in"]).sum()) > 0
+
+
+def test_moe_capacity_drops_overflow():
+    """With capacity ~1 token/expert, most tokens are dropped: their
+    output rows are exactly zero (residual passthrough upstream)."""
+    layer = _layer(e=2, k=1, cap=0.05)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 64, 16))
+    params = layer.init(jax.random.PRNGKey(1), x)
+    y = layer.apply(params, x)
+    row_norms = np.asarray(jnp.abs(y[0]).sum(-1))
+    assert (row_norms == 0).sum() >= 60  # nearly all dropped
+    assert (row_norms > 0).sum() >= 1    # but capacity slots were used
+
+
+def test_moe_aux_loss_balanced_vs_skewed():
+    """The Switch aux loss is minimal (=1) for a uniform router and
+    larger for a collapsed one."""
+    e = 4
+    s = 1024
+    probs_uniform = jnp.full((s, e), 1 / e)
+    probs_skewed = jnp.concatenate(
+        [jnp.full((s, 1), 0.97), jnp.full((s, e - 1), 0.01)], axis=1)
+    for probs, expect_min in ((probs_uniform, True),
+                              (probs_skewed, False)):
+        idx = jnp.argmax(probs, -1)
+        f = jax.nn.one_hot(idx, e).mean(0)
+        p = probs.mean(0)
+        aux = float(e * jnp.sum(f * p))
+        if expect_min:
+            assert abs(aux - 1.0) < 1e-5
+        else:
+            assert aux > 2.0
+
+
+def test_gpt2_moe_trains():
+    from ray_tpu.train.train_step import (TrainState, make_optimizer,
+                                          make_train_step)
+
+    cfg = GPT2Config(vocab_size=256, n_layer=2, n_head=2, d_model=64,
+                     d_ff=128, max_seq=32, remat=False,
+                     dtype=jnp.float32, moe_num_experts=4, moe_every=2)
+    params = gpt2_init(cfg, jax.random.PRNGKey(0))
+    # MoE params exist on the alternating layer only.
+    assert "moe_mlp" in params["params"]["h_1"]
+    assert "moe_mlp" not in params["params"]["h_0"]
+    opt = make_optimizer(total_steps=30)
+    state = TrainState.create(params, opt)
+    step = make_train_step(
+        lambda p, b: gpt2_loss_fn(cfg, p, b, loss_chunk=0), opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0, 256)
+    losses = []
+    for _ in range(12):
+        state, m = step(state, {"tokens": tokens})
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_gpt2_moe_expert_parallel_mesh():
+    """Full sharded train step with a real expert mesh axis on the
+    8-device virtual CPU mesh (DP x EP x TP)."""
+    from ray_tpu.parallel import MeshSpec, create_mesh
+    from ray_tpu.parallel.sharding import ShardingRules, logical_sharding
+    from ray_tpu.train.train_step import (TrainState, make_optimizer,
+                                          make_sharded_train_step,
+                                          shard_state)
+
+    mesh = create_mesh(MeshSpec(data=2, expert=2, tensor=2))
+    rules = ShardingRules()
+    cfg = GPT2Config(vocab_size=128, n_layer=2, n_head=4, d_model=64,
+                     d_ff=128, max_seq=32, remat=True, mesh=mesh,
+                     rules=rules, moe_num_experts=4, moe_every=2)
+    params = gpt2_init(cfg, jax.random.PRNGKey(0))
+    opt = make_optimizer(total_steps=10)
+    state = TrainState.create(params, opt)
+    state = shard_state(state, mesh, gpt2_param_axes, rules)
+    # Expert weights are actually sharded over the expert axis.
+    w_in = state.params["params"]["h_1"]["moe_mlp"]["w_in"]
+    assert "expert" in str(w_in.sharding.spec)
+    step = make_sharded_train_step(
+        lambda p, b: gpt2_loss_fn(cfg, p, b, loss_chunk=0), opt, mesh)
+    tokens = jax.device_put(
+        jnp.zeros((4, 33), jnp.int32),
+        logical_sharding(mesh, ("batch", None), rules))
+    state, metrics = step(state, {"tokens": tokens})
+    jax.block_until_ready(metrics)
+    assert np.isfinite(float(metrics["loss"]))
