@@ -69,6 +69,21 @@ class Cluster:
             pass
         self.nodes.remove(node)
 
+    def kill_controller(self) -> None:
+        """SIGKILL the controller (GCS fault injection)."""
+        self._controller_proc.kill()
+        self._controller_proc.wait(timeout=10)
+
+    def restart_controller(self) -> None:
+        """Start a fresh controller on the SAME address/session — the
+        GCS-restart scenario (ref: NotifyGCSRestart): with persistence
+        on, it reloads its tables and agents/drivers reconnect."""
+        from .core.net import port_of
+
+        self._controller_proc, addr = node_launcher.start_controller(
+            self.config, self.session, port=port_of(self.address))
+        assert addr == self.address, (addr, self.address)
+
     def wait_for_nodes(self, timeout: float = 30.0) -> None:
         """Block until every added node is registered and alive."""
         import ray_tpu

@@ -96,6 +96,18 @@ define_flag("metrics_report_period_s", float, 5.0,
 define_flag("task_event_buffer_size", int, 10000,
             "Max buffered per-task lifecycle events before drop-oldest.")
 define_flag("tracing_enabled", bool, False, "Emit task/actor spans.")
+define_flag("memory_usage_threshold", float, 0.95,
+            "Host memory-usage fraction above which the OOM monitor "
+            "kills workers running retriable work.")
+define_flag("memory_monitor_refresh_ms", int, 1000,
+            "OOM monitor sampling period; 0 disables the monitor.")
+define_flag("controller_persistence_enabled", bool, False,
+            "Snapshot controller tables to the session dir so a "
+            "restarted controller resumes (GCS fault tolerance).")
+define_flag("controller_reconnect_grace_s", float, 30.0,
+            "How long agents tolerate an unreachable controller "
+            "(reconnect window across a controller restart) before "
+            "shutting the node down.")
 define_flag("object_transfer_chunk_bytes", int, 4 * 1024**2,
             "Node-to-node object transfer chunk size; larger objects "
             "move as a sequence of chunk RPCs, not one giant frame.")

@@ -120,6 +120,7 @@ class Controller:
 
     # ------------------------------------------------------------------ util
     def _publish(self, channel: str, data: Any) -> None:
+        self._mark_dirty()  # every table mutation publishes
         self.event_seq += 1
         self.events.setdefault(channel, []).append((self.event_seq, data))
         log = self.events[channel]
@@ -287,6 +288,7 @@ class Controller:
                         "error": f"actor name {spec.actor_name!r} taken"}
             self.named_actors[key] = spec.actor_id
         self.actors[spec.actor_id] = entry
+        self._mark_dirty()
         return {"ok": True}
 
     async def actor_started(self, p):
@@ -447,6 +449,7 @@ class Controller:
     async def kv_del(self, p):
         self.kv.pop(p["key"], None)
         self.kv_list_counts.pop(p["key"], None)
+        self._mark_dirty()
         return {"ok": True}
 
     async def kv_keys(self, p):
@@ -658,6 +661,7 @@ class Controller:
             if state:
                 rec["state"] = state
                 rec["times"][state] = ev["ts"]
+        self._mark_dirty()
         return {"ok": True}
 
     async def list_tasks(self, p):
@@ -747,12 +751,14 @@ class Controller:
         self.job_counter += 1
         self.jobs[jid] = {"start": time.time(), "driver": p.get("driver", ""),
                           "alive": True}
+        self._mark_dirty()
         return {"job_id": jid}
 
     async def finish_job(self, p):
         job = self.jobs.get(p["job_id"])
         if job:
             job["alive"] = False
+            self._mark_dirty()
         return {"ok": True}
 
     # ------------------------------------------------------ placement groups
@@ -788,11 +794,108 @@ class Controller:
         from .placement import PlacementGroupManager
 
         self._placement = PlacementGroupManager(self)
+        if self.config.controller_persistence_enabled:
+            self._snapshot_path = os.path.join(
+                self.config.session_dir_root, self.session,
+                "controller_state.pkl")
+            self._load_snapshot()
+            spawn_task(self._persist_loop())
         await self.server.start(port)
         spawn_task(self._health_loop())
         if driver_pid:
             spawn_task(self._watch_driver(driver_pid))
         return self.server.port
+
+    # ------------------------------------------- persistence (GCS FT)
+    # Ref: gcs_server.h:113 StorageType + Redis-backed tables; redesigned
+    # as a debounced whole-state snapshot — controller state at TPU-host
+    # granularity is kilobytes, so one atomic pickle beats a table store.
+    def _mark_dirty(self) -> None:
+        self._dirty = True
+
+    _PERSIST_CHANNELS = ("actor", "node", "kv", "placement_group",
+                         "object_lost")
+
+    def _snapshot_state(self) -> Dict[str, Any]:
+        pgs = []
+        if self._placement is not None:
+            for e in self._placement._groups.values():
+                pgs.append({
+                    "pg_id": e.pg_id, "bundles": e.bundles,
+                    "strategy": e.strategy, "state": e.state,
+                    "name": e.name, "placement": dict(e.placement)})
+        return {
+            "kv": self.kv, "kv_list_counts": self.kv_list_counts,
+            "actors": self.actors, "named_actors": self.named_actors,
+            "jobs": self.jobs, "job_counter": self.job_counter,
+            "task_records": self.task_records,
+            "task_events_dropped": self.task_events_dropped,
+            "event_seq": self.event_seq,
+            "placement_groups": pgs,
+        }
+
+    async def _persist_loop(self) -> None:
+        import pickle
+
+        self._dirty = True
+        while not self._shutdown.is_set():
+            await asyncio.sleep(0.5)
+            if not getattr(self, "_dirty", False):
+                continue
+            self._dirty = False
+            try:
+                data = pickle.dumps(self._snapshot_state())
+                tmp = self._snapshot_path + ".tmp"
+
+                def _write():
+                    os.makedirs(os.path.dirname(self._snapshot_path),
+                                exist_ok=True)
+                    with open(tmp, "wb") as f:
+                        f.write(data)
+                    os.replace(tmp, self._snapshot_path)
+
+                await asyncio.get_event_loop().run_in_executor(None,
+                                                               _write)
+            except Exception:
+                # Persistence must degrade loudly, not die silently: a
+                # frozen snapshot restores arbitrarily stale state.
+                logger.exception("controller snapshot failed; retrying "
+                                 "next cycle")
+                self._dirty = True
+
+    def _load_snapshot(self) -> None:
+        import pickle
+
+        try:
+            with open(self._snapshot_path, "rb") as f:
+                state = pickle.load(f)
+        except (OSError, pickle.UnpicklingError, EOFError):
+            return
+        self.kv = state["kv"]
+        self.kv_list_counts = state["kv_list_counts"]
+        self.actors = state["actors"]
+        self.named_actors = state["named_actors"]
+        self.jobs = state["jobs"]
+        self.job_counter = state["job_counter"]
+        self.task_records = state["task_records"]
+        self.task_events_dropped = state["task_events_dropped"]
+        # Event history is gone: continue the sequence and mark all of
+        # it trimmed, so every live subscriber gets cursor_expired and
+        # resyncs instead of silently missing transitions.
+        self.event_seq = state["event_seq"]
+        for ch in self._PERSIST_CHANNELS:
+            self.events_trimmed_to[ch] = self.event_seq
+        from .placement import PGEntry
+
+        for rec in state["placement_groups"]:
+            entry = PGEntry(pg_id=rec["pg_id"], bundles=rec["bundles"],
+                            strategy=rec["strategy"], state=rec["state"],
+                            name=rec["name"])
+            entry.placement = rec["placement"]
+            self._placement._groups[rec["pg_id"]] = entry
+        logger.info("restored controller state: %d actors, %d kv keys, "
+                    "%d jobs, %d PGs", len(self.actors), len(self.kv),
+                    len(self.jobs), len(state["placement_groups"]))
 
     async def _watch_driver(self, pid: int) -> None:
         """Head clusters spawned by a driver die with it (atexit handles

@@ -137,7 +137,7 @@ class NodeAgent:
             "object_exists", "store_stats",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "restart_actor", "kill_worker", "report_actor_failure",
-            "drain", "shutdown", "ping", "node_info",
+            "drain", "shutdown", "ping", "node_info", "list_workers",
         ]:
             self.server.register(name, getattr(self, name))
 
@@ -188,13 +188,15 @@ class NodeAgent:
             "is_head": self.is_head})
         spawn_task(self._heartbeat_loop())
         spawn_task(self._reap_loop())
+        if self.config.memory_monitor_refresh_ms > 0:
+            spawn_task(self._memory_monitor_loop())
         for _ in range(self.config.worker_pool_min_workers):
             self._spawn_worker()
         return self.server.port
 
     async def _heartbeat_loop(self) -> None:
         period = self.config.raylet_heartbeat_period_ms / 1000.0
-        misses = 0
+        first_miss = None
         last_metrics = 0.0
         self._last_busy = time.time()
         while not self._shutdown.is_set():
@@ -222,21 +224,103 @@ class NodeAgent:
                         "source": f"node-{self.node_id.hex()[:8]}",
                         "snapshot": self._node_metrics_snapshot()})
                 if r.get("reregister"):
+                    # Fresh (possibly restarted) controller: rebuild our
+                    # node row AND our object locations (the location
+                    # directory is not persisted; ref: NotifyGCSRestart
+                    # node_manager.proto:387 resend path).
                     await self._ctl.call("register_node", {
                         "node_id": self.node_id,
                         "agent_addr": self.server.address,
                         "resources": dict(self.total.amounts),
                         "labels": self.labels, "is_head": self.is_head})
-                misses = 0
+                    objs = [(oid, ent.size) for oid, ent in
+                            [(o, self.directory.lookup(o))
+                             for o in self.directory.all_ids()]
+                            if ent is not None]
+                    if objs:
+                        await self._ctl.call("publish_locations", {
+                            "node_id": self.node_id, "objects": objs})
+                first_miss = None
             except RpcError:
-                misses += 1
-                if misses >= 3:
-                    # Controller is gone: this node has no cluster; exit
-                    # and take workers down (no orphan process trees).
-                    logger.warning("controller unreachable; shutting down")
+                now = time.time()
+                if first_miss is None:
+                    first_miss = now
+                # Tolerate a restart window: RpcClient re-dials on the
+                # next call, so a controller that comes back on the same
+                # address within the grace resumes us transparently.
+                if now - first_miss > \
+                        self.config.controller_reconnect_grace_s:
+                    logger.warning("controller unreachable for %.0fs; "
+                                   "shutting down",
+                                   now - first_miss)
                     await self.shutdown()
                     return
             await asyncio.sleep(period)
+
+    @staticmethod
+    def _memory_usage_fraction() -> float:
+        """Host memory pressure from /proc/meminfo (ref:
+        common/memory_monitor.h GetMemoryBytes — cgroup-aware there;
+        host-level here, which matches one-agent-per-TPU-host)."""
+        total = avail = None
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = int(line.split()[1])
+                    elif line.startswith("MemAvailable:"):
+                        avail = int(line.split()[1])
+                    if total is not None and avail is not None:
+                        break
+        except OSError:
+            return 0.0
+        if not total or avail is None:
+            return 0.0  # no MemAvailable (old kernel): monitor inert
+        return 1.0 - avail / total
+
+    def _pick_oom_victim(self) -> Optional["Lease"]:
+        """Retriable-task-first, newest-first (ref:
+        worker_killing_policy.h RetriableFIFOWorkerKillingPolicy):
+        normal tasks retry transparently; actors lose state, so they go
+        last — and only when they are restartable is that survivable."""
+        task_leases = [ls for ls in self.leases.values()
+                       if ls.worker.state == "leased"]
+        if task_leases:
+            return max(task_leases, key=lambda ls: ls.lease_id)
+        actor_leases = [ls for ls in self.leases.values()
+                        if ls.worker.state == "actor"]
+        if actor_leases:
+            return max(actor_leases, key=lambda ls: ls.lease_id)
+        return None
+
+    async def _memory_monitor_loop(self) -> None:
+        """Kill workers under host memory pressure instead of letting
+        the OS OOM killer take the agent (ref: memory_monitor.h +
+        worker_killing_policy.h)."""
+        period = self.config.memory_monitor_refresh_ms / 1000.0
+        threshold = self.config.memory_usage_threshold
+        while not self._shutdown.is_set():
+            await asyncio.sleep(period)
+            usage = self._memory_usage_fraction()
+            if usage <= threshold:
+                continue
+            victim = self._pick_oom_victim()
+            if victim is None:
+                continue
+            w = victim.worker
+            logger.warning(
+                "memory pressure %.1f%% > %.1f%%: killing worker %s "
+                "(lease %d) to reclaim memory", usage * 100,
+                threshold * 100, w.pid, victim.lease_id)
+            try:
+                if w.proc is not None:
+                    w.proc.kill()
+                else:
+                    os.kill(w.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            # The reap loop notices the death, releases the lease, and
+            # the owner's retry machinery resubmits retriable work.
 
     async def _reap_loop(self) -> None:
         """Detect worker process exits (ref: worker_pool.cc monitoring)."""
@@ -1134,6 +1218,14 @@ class NodeAgent:
 
     async def ping(self, _p):
         return {"ok": True, "node_id": self.node_id}
+
+    async def list_workers(self, _p):
+        """Worker inventory (chaos killers + debugging)."""
+        return {"workers": [
+            {"pid": w.pid, "state": w.state,
+             "worker_id": w.worker_id.hex(),
+             "actor_id": w.actor_id.hex() if w.actor_id else None}
+            for w in self.workers.values()]}
 
     async def node_info(self, _p):
         return {"node_id": self.node_id, "addr": self.server.address,

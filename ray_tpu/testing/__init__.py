@@ -1,0 +1,8 @@
+"""ray_tpu.testing — fault-injection helpers for tests.
+
+Role-equivalent to the reference's chaos test utilities (ref:
+python/ray/_private/test_utils.py:1511 ResourceKillerActor /
+NodeKillerBase / WorkerKillerActor).
+"""
+
+from .chaos import NodeKiller, WorkerKiller  # noqa

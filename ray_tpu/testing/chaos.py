@@ -1,0 +1,94 @@
+"""Chaos killers: background threads that keep killing cluster pieces.
+
+Role-equivalent to the reference's chaos fixtures (ref:
+python/ray/_private/test_utils.py — NodeKillerBase:1581 kills raylets,
+WorkerKillerActor:1678 kills task workers mid-run).  Process-based
+rather than actor-based: the single-machine Cluster fixture exposes the
+OS processes directly, so killers operate on pids — the failure the
+system sees (SIGKILL, no goodbye) is identical.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import List, Optional
+
+
+class _KillerThread:
+    def __init__(self, interval_s: float, seed: int):
+        self._interval = interval_s
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self.kills: List[int] = []
+
+    def start(self) -> "_KillerThread":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                pid = self._pick()
+            except Exception:
+                continue
+            if pid is None:
+                continue
+            try:
+                os.kill(pid, signal.SIGKILL)
+                self.kills.append(pid)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def _pick(self) -> Optional[int]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class NodeKiller(_KillerThread):
+    """Kills a random non-head node agent from a Cluster fixture (ref:
+    NodeKillerBase)."""
+
+    def __init__(self, cluster, interval_s: float = 5.0, seed: int = 0,
+                 spare_head: bool = True):
+        super().__init__(interval_s, seed)
+        self._cluster = cluster
+        self._spare_head = spare_head
+
+    def _pick(self) -> Optional[int]:
+        nodes = list(self._cluster.nodes)
+        if self._spare_head and nodes:
+            nodes = nodes[1:]
+        live = [n for n in nodes if n.proc.poll() is None]
+        if not live:
+            return None
+        victim = self._rng.choice(live)
+        return victim.proc.pid
+
+
+class WorkerKiller(_KillerThread):
+    """Kills a random live worker process of the given agents (ref:
+    WorkerKillerActor — kills the process executing a task, exercising
+    retry paths)."""
+
+    def __init__(self, agent_call, interval_s: float = 2.0,
+                 seed: int = 0):
+        """``agent_call(method, payload)`` reaches a node agent (e.g.
+        ``runtime.agent_call``)."""
+        super().__init__(interval_s, seed)
+        self._agent_call = agent_call
+
+    def _pick(self) -> Optional[int]:
+        info = self._agent_call("list_workers", {})
+        pids = [w["pid"] for w in info.get("workers", [])
+                if w.get("state") in ("leased", "actor")]
+        if not pids:
+            return None
+        return self._rng.choice(pids)

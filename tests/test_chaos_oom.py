@@ -1,0 +1,121 @@
+"""OOM monitor worker-killing policy + chaos killer fixtures.
+
+Ref: common/memory_monitor.h + raylet/worker_killing_policy.h (OOM) and
+python/ray/_private/test_utils.py:1511 chaos killers — VERDICT round-1
+missing item 14.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_oom_monitor_kills_retriable_task():
+    """With a zero threshold every sample is 'pressure': the monitor
+    kills the task worker and the owner's retry machinery absorbs it
+    until retries run out with a crash error (not a hang)."""
+    os.environ["RT_MEMORY_USAGE_THRESHOLD"] = "0.0"
+    os.environ["RT_MEMORY_MONITOR_REFRESH_MS"] = "200"
+    try:
+        ray_tpu.init(mode="cluster", num_cpus=1)
+
+        @ray_tpu.remote(max_retries=1)
+        def hog():
+            time.sleep(30)
+            return "survived"
+
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(hog.remote(), timeout=120)
+        assert "crash" in str(ei.value).lower() or \
+            "died" in str(ei.value).lower(), ei.value
+    finally:
+        os.environ.pop("RT_MEMORY_USAGE_THRESHOLD", None)
+        os.environ.pop("RT_MEMORY_MONITOR_REFRESH_MS", None)
+        ray_tpu.shutdown()
+
+
+def test_oom_monitor_spares_idle_cluster():
+    """Zero threshold but nothing running: the monitor must not kill
+    idle workers (only leased ones are victims)."""
+    os.environ["RT_MEMORY_USAGE_THRESHOLD"] = "0.0"
+    os.environ["RT_MEMORY_MONITOR_REFRESH_MS"] = "200"
+    try:
+        ray_tpu.init(mode="cluster", num_cpus=1)
+
+        @ray_tpu.remote
+        def quick():
+            return 7
+
+        # Warm a worker, let it go idle, wait several monitor periods.
+        assert ray_tpu.get(quick.remote(), timeout=60) == 7
+        time.sleep(1.5)
+        # Fast tasks keep working (racing the monitor is possible, so
+        # retries are on — the point is the idle pool isn't destroyed).
+        assert ray_tpu.get(quick.options(max_retries=5).remote(),
+                           timeout=60) == 7
+    finally:
+        os.environ.pop("RT_MEMORY_USAGE_THRESHOLD", None)
+        os.environ.pop("RT_MEMORY_MONITOR_REFRESH_MS", None)
+        ray_tpu.shutdown()
+
+
+def test_chaos_node_killer_tasks_still_complete():
+    """A NodeKiller SIGKILLs worker nodes mid-run; retriable tasks all
+    complete via retry on surviving nodes (ref: chaos release tests)."""
+    from ray_tpu.testing import NodeKiller
+
+    cluster = None
+    killer = None
+    try:
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 2})
+        cluster.add_node(num_cpus=2)
+        ray_tpu.init(address=cluster.address)
+
+        killer = NodeKiller(cluster, interval_s=3.0, seed=1).start()
+
+        @ray_tpu.remote(max_retries=8)
+        def work(i):
+            time.sleep(0.3)
+            return i * i
+
+        refs = [work.remote(i) for i in range(24)]
+        out = ray_tpu.get(refs, timeout=240)
+        assert out == [i * i for i in range(24)]
+        assert killer.kills, "chaos killer never fired"
+    finally:
+        if killer is not None:
+            killer.stop()
+        ray_tpu.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
+
+
+def test_chaos_worker_killer_with_retries():
+    from ray_tpu.core import runtime as _rm
+    from ray_tpu.testing import WorkerKiller
+
+    killer = None
+    try:
+        ray_tpu.init(mode="cluster", num_cpus=2)
+        rt = _rm.get_runtime()
+        killer = WorkerKiller(rt.agent_call, interval_s=0.7,
+                              seed=3).start()
+
+        @ray_tpu.remote(max_retries=10)
+        def slowish(i):
+            time.sleep(0.4)
+            return i + 1
+
+        out = ray_tpu.get([slowish.remote(i) for i in range(16)],
+                          timeout=240)
+        assert out == [i + 1 for i in range(16)]
+        assert killer.kills, "worker killer never fired"
+    finally:
+        if killer is not None:
+            killer.stop()
+        ray_tpu.shutdown()

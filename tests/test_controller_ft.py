@@ -1,0 +1,115 @@
+"""Controller (GCS) fault tolerance: SIGKILL the controller, restart it
+on the same address, and the cluster — agents, drivers, named actors,
+KV, object locations — resumes.
+
+Ref: gcs_server.h:113 StorageType persistence + NotifyGCSRestart
+(node_manager.proto:387) — VERDICT round-1 missing item 12.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def ft_cluster():
+    os.environ["RT_CONTROLLER_PERSISTENCE_ENABLED"] = "1"
+    cluster = None
+    try:
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 2})
+        ray_tpu.init(address=cluster.address)
+        yield cluster
+    finally:
+        os.environ.pop("RT_CONTROLLER_PERSISTENCE_ENABLED", None)
+        ray_tpu.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
+
+
+def test_controller_restart_preserves_state(ft_cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.options(name="ft_counter", lifetime="detached").remote()
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+    from ray_tpu.core import runtime as _rm
+    rt = _rm.get_runtime()
+    rt.controller_call("kv_put", {"key": "ft/marker",
+                                  "value": b"survives"})
+    big = np.arange(200_000, dtype=np.float64)
+    big_ref = ray_tpu.put(big)
+    time.sleep(1.5)  # let the persist loop snapshot the latest state
+
+    ft_cluster.kill_controller()
+    time.sleep(2.0)  # agents ride the reconnect grace
+    ft_cluster.restart_controller()
+
+    # KV survived the restart.
+    deadline = time.time() + 60
+    val = None
+    while time.time() < deadline:
+        try:
+            val = rt.controller_call("kv_get", {"key": "ft/marker"})
+            if val == b"survives":
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert val == b"survives"
+
+    # The named actor is still resolvable and LIVE (same instance:
+    # counter state is intact in its worker process).
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            c2 = ray_tpu.get_actor("ft_counter")
+            assert ray_tpu.get(c2.inc.remote(), timeout=30) == 2
+            break
+        except Exception:
+            time.sleep(0.5)
+    else:
+        raise TimeoutError("named actor never resolved after restart")
+
+    # Object locations were republished: the plane object still reads.
+    got = ray_tpu.get(big_ref, timeout=60)
+    np.testing.assert_array_equal(got, big)
+
+    # And new work schedules normally.
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    assert ray_tpu.get(f.remote(21), timeout=60) == 42
+
+
+def test_agent_exits_after_grace_without_controller():
+    os.environ["RT_CONTROLLER_RECONNECT_GRACE_S"] = "3"
+    cluster = None
+    try:
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 1})
+        agent_proc = cluster.head_node.proc
+        cluster.kill_controller()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if agent_proc.poll() is not None:
+                break
+            time.sleep(0.5)
+        assert agent_proc.poll() is not None, \
+            "agent outlived the reconnect grace"
+    finally:
+        os.environ.pop("RT_CONTROLLER_RECONNECT_GRACE_S", None)
+        if cluster is not None:
+            cluster.shutdown()
