@@ -1,0 +1,57 @@
+"""ray_tpu._native — C++ runtime components (ctypes-bound).
+
+Built lazily from ``src/`` with the system toolchain on first use and
+cached per source-hash; everything here is optional — callers fall back
+to the pure-Python paths when a compiler is unavailable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC_DIR = os.path.join(_REPO, "src")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_build")
+_lock = threading.Lock()
+
+
+def build_library(source: str, extra_flags=()) -> Optional[str]:
+    """Compile ``src/<source>`` into a cached .so; returns its path or
+    None if no toolchain / compile failure."""
+    src_path = os.path.join(_SRC_DIR, source)
+    try:
+        with open(src_path, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+    out = os.path.join(_BUILD_DIR,
+                       f"{os.path.splitext(source)[0]}-{digest}.so")
+    if os.path.exists(out):
+        return out
+    with _lock:
+        if os.path.exists(out):
+            return out
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = out + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               src_path, "-o", tmp, "-lpthread", "-lrt",
+               *extra_flags]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            import logging
+
+            logging.getLogger("ray_tpu.native").warning(
+                "native build of %s failed:\n%s", source, proc.stderr)
+            return None
+        os.replace(tmp, out)
+        return out

@@ -1,0 +1,170 @@
+"""ctypes binding for the C++ shared-memory object pool (src/shm_pool.cpp).
+
+The pool is the native backing for the node object store: one shm
+region per session per host, attached by agent, workers, and driver.
+Payload reads/writes are zero-copy memoryview slices of the mapping;
+index and allocator operations go through the C API under the pool's
+process-shared robust mutex.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap as _mmap
+from multiprocessing import shared_memory
+from typing import Optional, Tuple
+
+from . import build_library
+
+_NONE = (1 << 64) - 1
+
+
+class ShmPool:
+    _lib = None
+
+    @classmethod
+    def _load(cls):
+        if cls._lib is not None:
+            return cls._lib
+        path = build_library("shm_pool.cpp")
+        if path is None:
+            raise RuntimeError("native shm_pool unavailable "
+                               "(no toolchain or build failed)")
+        lib = ctypes.CDLL(path)
+        lib.rt_pool_create.restype = ctypes.c_void_p
+        lib.rt_pool_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                       ctypes.c_uint64]
+        lib.rt_pool_attach.restype = ctypes.c_void_p
+        lib.rt_pool_attach.argtypes = [ctypes.c_char_p]
+        lib.rt_pool_alloc.restype = ctypes.c_uint64
+        lib.rt_pool_alloc.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64]
+        lib.rt_pool_seal.restype = ctypes.c_int
+        lib.rt_pool_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_pool_lookup.restype = ctypes.c_uint64
+        lib.rt_pool_lookup.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.rt_pool_delete.restype = ctypes.c_int
+        lib.rt_pool_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_pool_pin.restype = ctypes.c_uint64
+        lib.rt_pool_pin.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.rt_pool_unpin.restype = ctypes.c_int
+        lib.rt_pool_unpin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_pool_contains.restype = ctypes.c_int
+        lib.rt_pool_contains.argtypes = [ctypes.c_void_p,
+                                         ctypes.c_char_p]
+        lib.rt_pool_stats.argtypes = [ctypes.c_void_p] + \
+            [ctypes.POINTER(ctypes.c_uint64)] * 3
+        lib.rt_pool_close.argtypes = [ctypes.c_void_p]
+        lib.rt_pool_unlink.restype = ctypes.c_int
+        lib.rt_pool_unlink.argtypes = [ctypes.c_char_p]
+        cls._lib = lib
+        return lib
+
+    def __init__(self, name: str, slab_bytes: int = 0,
+                 table_slots: int = 65536, create: bool = True):
+        lib = self._load()
+        self._name = name
+        if create:
+            self._h = lib.rt_pool_create(name.encode(), slab_bytes,
+                                         table_slots)
+        else:
+            self._h = lib.rt_pool_attach(name.encode())
+        if not self._h:
+            raise OSError(f"cannot open shm pool {name!r}")
+        # Map the same region in-process for zero-copy payload access.
+        # (SharedMemory tracks via resource_tracker; detach that — the
+        # pool's lifetime belongs to the session, not this process.)
+        self._seg = shared_memory.SharedMemory(name=name.lstrip("/"))
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(self._seg._name, "shared_memory")
+        except Exception:
+            pass
+        self.buf = self._seg.buf
+
+    # ------------------------------------------------------------ object ops
+    def alloc(self, key: bytes, size: int) -> Optional[memoryview]:
+        """Reserve a block; returns a writable view (caller fills it,
+        then seal()s).  None when full or the key exists."""
+        off = self._load().rt_pool_alloc(self._h, key, size)
+        if off == _NONE:
+            return None
+        return self.buf[off:off + size]
+
+    def seal(self, key: bytes) -> bool:
+        return self._load().rt_pool_seal(self._h, key) == 0
+
+    def put(self, key: bytes, data) -> bool:
+        """Alloc+copy+seal; False when the pool is full or key exists."""
+        view = self.alloc(key, len(data))
+        if view is None:
+            return False
+        view[:] = data
+        return self.seal(key)
+
+    def get(self, key: bytes) -> Optional[memoryview]:
+        """Zero-copy view of a sealed object's payload.  UNSAFE against
+        concurrent delete — use get_copy() unless the caller pins."""
+        lib = self._load()
+        size = ctypes.c_uint64()
+        off = lib.rt_pool_lookup(self._h, key, ctypes.byref(size))
+        if off == _NONE:
+            return None
+        return self.buf[off:off + size.value]
+
+    def get_copy(self, key: bytes, offset: int = 0,
+                 length: Optional[int] = None) -> Optional[bytes]:
+        """Copy out (a slice of) a sealed payload under a read pin, so
+        a concurrent delete can never recycle the bytes mid-read."""
+        lib = self._load()
+        size = ctypes.c_uint64()
+        off = lib.rt_pool_pin(self._h, key, ctypes.byref(size))
+        if off == _NONE:
+            return None
+        try:
+            end = size.value if length is None \
+                else min(offset + length, size.value)
+            return bytes(self.buf[off + offset:off + end])
+        finally:
+            lib.rt_pool_unpin(self._h, key)
+
+    def delete(self, key: bytes) -> bool:
+        return self._load().rt_pool_delete(self._h, key) == 0
+
+    def contains(self, key: bytes) -> bool:
+        return bool(self._load().rt_pool_contains(self._h, key))
+
+    def stats(self) -> Tuple[int, int, int]:
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        n = ctypes.c_uint64()
+        self._load().rt_pool_stats(self._h, ctypes.byref(used),
+                                   ctypes.byref(cap), ctypes.byref(n))
+        return used.value, cap.value, n.value
+
+    def close(self) -> None:
+        if self._h:
+            try:
+                self.buf.release()
+                self._seg.close()
+            except BufferError:
+                # Zero-copy views into the mapping are still alive
+                # somewhere; abandon the Python mapping (the OS reclaims
+                # at process exit) rather than invalidating them.
+                pass
+            except Exception:
+                pass
+            self._load().rt_pool_close(self._h)
+            self._h = None
+
+    @classmethod
+    def unlink(cls, name: str) -> None:
+        try:
+            cls._load().rt_pool_unlink(name.encode())
+        except Exception:
+            pass

@@ -110,7 +110,9 @@ class ClusterRuntime(BaseRuntime):
                                  custom_resources)
             self._owns_head = True
         self.io = EventLoopThread("rt-io")
-        self.store = SharedObjectStore(self.session)
+        from .object_store import create_store
+
+        self.store = create_store(self.session, config)
         self.memory = MemoryStore()
         self._runtime_id = uuid.uuid4().hex[:16]
         self._ctl: Optional[RpcClient] = None

@@ -111,6 +111,10 @@ define_flag("controller_reconnect_grace_s", float, 30.0,
 define_flag("object_transfer_chunk_bytes", int, 4 * 1024**2,
             "Node-to-node object transfer chunk size; larger objects "
             "move as a sequence of chunk RPCs, not one giant frame.")
+define_flag("object_store_backend", str, "segments",
+            "Node object store backing: 'segments' (one shm segment "
+            "per object) or 'pool' (native C++ slab allocator over one "
+            "shm region, src/shm_pool.cpp).")
 define_flag("object_spill_enabled", bool, True,
             "Spill pinned objects to disk under store pressure instead "
             "of running over capacity.")

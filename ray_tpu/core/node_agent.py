@@ -102,7 +102,15 @@ class NodeAgent:
         n_chips = int(self.total.get("TPU"))
         self.free_chips: List[int] = list(range(n_chips))
         self.server = RpcServer()
-        self.store = SharedObjectStore(session)
+        from .object_store import PoolObjectStore, create_store
+
+        self.store = create_store(session, config)
+        # Workers must use the SAME backend this agent resolved — a
+        # silent per-process fallback would split the node across two
+        # object planes.
+        self._store_backend = ("pool" if isinstance(self.store,
+                                                    PoolObjectStore)
+                               else "segments")
         spill_dir = None
         if config.object_spill_enabled:
             spill_dir = os.path.join(
@@ -374,6 +382,7 @@ class NodeAgent:
             "RT_CONTROLLER_ADDR": self.controller_addr,
             "RT_AGENT_ADDR": self.server.address,
             "RT_NODE_ID": self.node_id.hex(),
+            "RT_OBJECT_STORE_BACKEND": self._store_backend,
         })
         log_dir = os.path.join(self.config.session_dir_root, self.session,
                                "logs")
@@ -1236,6 +1245,11 @@ class NodeAgent:
 
     async def shutdown(self, _p=None):
         self._shutdown.set()
+        if self.is_head and self._store_backend == "pool":
+            try:
+                self.store.unlink()  # session over: free the tmpfs slab
+            except Exception:
+                pass
         for w in self.workers.values():
             if w.proc is not None:
                 try:

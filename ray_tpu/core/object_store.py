@@ -213,6 +213,126 @@ class SharedObjectStore:
             self._mapped.clear()
 
 
+class PoolObjectStore:
+    """SharedObjectStore-compatible facade over the native C++ pool
+    (src/shm_pool.cpp): one shm region per session per host instead of a
+    segment per object — object creation is a lock + free-list carve
+    with no per-object shm_open/ftruncate syscalls, and reads are
+    zero-copy views into the shared mapping (the plasma shape, ref:
+    src/ray/object_manager/plasma/).
+    """
+
+    # Physical slab = 4x the logical capacity: the directory enforces
+    # the logical limit via eviction/spilling, transient read windows
+    # may overshoot (same policy as the segment backend), and slab
+    # pages are only backed when touched, so slack is nearly free.
+    SLACK = 4
+
+    def __init__(self, session: str, capacity_bytes: int):
+        from .._native.shm_pool import ShmPool
+
+        self._session = session
+        self._pool = ShmPool(f"/rtpool_{session}",
+                             slab_bytes=capacity_bytes * self.SLACK,
+                             table_slots=1 << 16)
+
+    @staticmethod
+    def _key(oid: ObjectID) -> bytes:
+        return oid.binary()
+
+    # -- producer side --------------------------------------------------
+    def create_and_seal(self, oid: ObjectID, value: Any) -> int:
+        payload, views = serialization.serialize(value)
+        return self.seal_parts(oid, payload, views)
+
+    def seal_parts(self, oid: ObjectID, payload: bytes, views) -> int:
+        size = serialization.packed_size(payload, views)
+        key = self._key(oid)
+        buf = self._pool.alloc(key, size)
+        if buf is None:
+            self._pool.delete(key)  # replace a stale sealed copy
+            buf = self._pool.alloc(key, size)
+            if buf is None:
+                raise OSError(f"shm pool full sealing {oid.hex()}")
+        pos = 0
+        buf[pos:pos + 4] = len(views).to_bytes(4, "little"); pos += 4
+        buf[pos:pos + 8] = len(payload).to_bytes(8, "little"); pos += 8
+        buf[pos:pos + len(payload)] = payload; pos += len(payload)
+        for v in views:
+            n = len(v)
+            buf[pos:pos + 8] = n.to_bytes(8, "little"); pos += 8
+            if n:
+                buf[pos:pos + n] = v
+            pos += n
+        if not self._pool.seal(key):
+            raise OSError(f"seal failed for {oid.hex()}")
+        return size
+
+    def put_raw(self, oid: ObjectID, data) -> int:
+        key = self._key(oid)
+        if not self._pool.put(key, data):
+            self._pool.delete(key)
+            if not self._pool.put(key, data):
+                raise OSError(f"shm pool full writing {oid.hex()}")
+        return len(data)
+
+    # -- consumer side --------------------------------------------------
+    # All reads copy out under a cross-process read pin: unlike the
+    # segment backend (whose unlinked mappings stay valid for live
+    # views), freed pool bytes are RECYCLED, so zero-copy views could
+    # silently change under a reader.  Correctness costs one memcpy.
+    def _copy(self, oid: ObjectID, offset: int = 0,
+              length=None) -> bytes:
+        data = self._pool.get_copy(self._key(oid), offset, length)
+        if data is None:
+            raise FileNotFoundError(oid.hex())
+        return data
+
+    def get(self, oid: ObjectID, size: int) -> Any:
+        return serialization.unpack(self._copy(oid, 0, size))
+
+    def read_raw(self, oid: ObjectID, size: int) -> bytes:
+        return self._copy(oid, 0, size)
+
+    def read_raw_slice(self, oid: ObjectID, offset: int,
+                       length: int) -> bytes:
+        return self._copy(oid, offset, length)
+
+    def contains(self, oid: ObjectID) -> bool:
+        return self._pool.contains(self._key(oid))
+
+    def release(self, oid: ObjectID) -> None:
+        pass  # views borrow the session-lifetime mapping
+
+    def delete(self, oid: ObjectID) -> None:
+        self._pool.delete(self._key(oid))
+
+    def close(self) -> None:
+        self._pool.close()
+
+    def unlink(self) -> None:
+        from .._native.shm_pool import ShmPool
+
+        ShmPool.unlink(f"/rtpool_{self._session}")
+
+
+def create_store(session: str, config) -> Any:
+    """Backend factory: ``object_store_backend`` = segments | pool
+    (pool requires the native toolchain; falls back to segments)."""
+    backend = getattr(config, "object_store_backend", "segments")
+    if backend == "pool":
+        try:
+            return PoolObjectStore(session,
+                                   config.object_store_memory_bytes)
+        except Exception:
+            import logging
+
+            logging.getLogger("ray_tpu.object_store").warning(
+                "native pool store unavailable; using segment store",
+                exc_info=True)
+    return SharedObjectStore(session)
+
+
 class StoreDirectory:
     """Node-agent-side authority over local objects: registration, LRU
     eviction under capacity pressure, pinning (ref: plasma eviction_policy.h
@@ -385,7 +505,16 @@ class StoreDirectory:
                 with self._lock:
                     ent = self._entries.get(oid)
                     return ent is not None and not ent.spilled
-            self._store.put_raw(oid, data)
+            try:
+                self._store.put_raw(oid, data)
+            except OSError:
+                # Pool backend can report full (fragmentation / shared
+                # slab): shed and retry once before giving up.
+                self._shed_pressure(protect=oid)
+                try:
+                    self._store.put_raw(oid, data)
+                except OSError:
+                    return False
             with self._lock:
                 ent = self._entries.get(oid)
                 if ent is None:

@@ -19,11 +19,13 @@ from typing import List, Optional
 
 
 class _KillerThread:
-    def __init__(self, interval_s: float, seed: int):
+    def __init__(self, interval_s: float, seed: int,
+                 max_kills: int = 0):
         self._interval = interval_s
         self._rng = random.Random(seed)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
+        self._max_kills = max_kills  # 0 = unbounded
         self.kills: List[int] = []
 
     def start(self) -> "_KillerThread":
@@ -36,6 +38,8 @@ class _KillerThread:
 
     def _run(self) -> None:
         while not self._stop.wait(self._interval):
+            if self._max_kills and len(self.kills) >= self._max_kills:
+                return
             try:
                 pid = self._pick()
             except Exception:
@@ -57,8 +61,8 @@ class NodeKiller(_KillerThread):
     NodeKillerBase)."""
 
     def __init__(self, cluster, interval_s: float = 5.0, seed: int = 0,
-                 spare_head: bool = True):
-        super().__init__(interval_s, seed)
+                 spare_head: bool = True, max_kills: int = 0):
+        super().__init__(interval_s, seed, max_kills)
         self._cluster = cluster
         self._spare_head = spare_head
 
@@ -79,10 +83,10 @@ class WorkerKiller(_KillerThread):
     retry paths)."""
 
     def __init__(self, agent_call, interval_s: float = 2.0,
-                 seed: int = 0):
+                 seed: int = 0, max_kills: int = 0):
         """``agent_call(method, payload)`` reaches a node agent (e.g.
         ``runtime.agent_call``)."""
-        super().__init__(interval_s, seed)
+        super().__init__(interval_s, seed, max_kills)
         self._agent_call = agent_call
 
     def _pick(self) -> Optional[int]:

@@ -1,0 +1,412 @@
+// Shared-memory object pool: the native data plane of the node store.
+//
+// Role-equivalent to the reference's plasma store core (ref:
+// src/ray/object_manager/plasma/ — ObjectStore over a dlmalloc slab with
+// an object table), redesigned for the one-agent-per-TPU-host layout:
+// ONE POSIX shm region holds a header + object index + data slab, and
+// every process on the host (agent, workers, driver) attaches the same
+// region.  Unlike the per-object-segment Python backend, creating an
+// object is a lock + free-list carve — no shm_open/ftruncate syscall per
+// object, no fd churn, and lookups are an open-addressed hash probe in
+// shared memory.
+//
+// Concurrency: a process-shared robust pthread mutex guards the index
+// and allocator (EOWNERDEAD is recovered with pthread_mutex_consistent,
+// so a SIGKILLed worker cannot wedge the host).  Object payloads are
+// written outside the lock: an object becomes visible to lookups only
+// when sealed, and objects are immutable after seal — the same
+// create/seal protocol as plasma.
+//
+// Allocator: address-ordered first-fit free list with split on carve and
+// coalesce on free.  O(free blocks) per alloc/free; the node store holds
+// thousands of objects, not millions, and the lock already serializes.
+
+#include <cstdint>
+#include <cstring>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <fcntl.h>
+#include <unistd.h>
+#include <errno.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545055504f4f4cULL;  // "RTPUPOOL"
+constexpr uint32_t kEmpty = 0;
+constexpr uint32_t kAllocated = 1;
+constexpr uint32_t kSealed = 2;
+constexpr uint32_t kTombstone = 3;
+constexpr uint32_t kPendingDelete = 4;
+
+struct Slot {
+  uint8_t key[16];
+  uint64_t off;       // data offset from slab base
+  uint64_t size;
+  uint32_t state;
+  uint32_t pins;      // cross-process read pins; free deferred while >0
+};
+
+struct FreeBlock {
+  uint64_t size;      // bytes of this free block (incl. header)
+  uint64_t next;      // offset of next free block, ~0ull = none
+};
+
+constexpr uint64_t kNone = ~0ull;
+constexpr uint64_t kAlign = 64;
+
+struct PoolHeader {
+  uint64_t magic;
+  uint64_t total_bytes;     // whole mapping
+  uint64_t slab_off;        // data slab start
+  uint64_t slab_bytes;
+  uint64_t table_off;
+  uint64_t table_slots;
+  uint64_t free_head;       // offset into slab of first free block
+  uint64_t used_bytes;
+  uint64_t n_objects;
+  pthread_mutex_t mutex;
+};
+
+struct Pool {
+  int fd;
+  uint8_t* base;
+  uint64_t map_bytes;
+  PoolHeader* hdr;
+};
+
+inline Slot* table(Pool* p) {
+  return reinterpret_cast<Slot*>(p->base + p->hdr->table_off);
+}
+
+inline uint64_t hash_key(const uint8_t* key) {
+  uint64_t h = 1469598103934665603ULL;  // FNV-1a over the 16-byte id
+  for (int i = 0; i < 16; i++) { h ^= key[i]; h *= 1099511628211ULL; }
+  return h;
+}
+
+int lock(Pool* p) {
+  int rc = pthread_mutex_lock(&p->hdr->mutex);
+  if (rc == EOWNERDEAD) {
+    // Holder died mid-critical-section.  Index/allocator mutations are
+    // small pointer swings; make the mutex usable again and continue —
+    // the worst case is a leaked block, never a corrupted reader.
+    pthread_mutex_consistent(&p->hdr->mutex);
+    rc = 0;
+  }
+  return rc;
+}
+
+void unlock(Pool* p) { pthread_mutex_unlock(&p->hdr->mutex); }
+
+Slot* find_slot(Pool* p, const uint8_t* key, bool for_insert) {
+  Slot* t = table(p);
+  uint64_t n = p->hdr->table_slots;
+  uint64_t i = hash_key(key) % n;
+  Slot* first_tomb = nullptr;
+  for (uint64_t probe = 0; probe < n; probe++, i = (i + 1) % n) {
+    Slot* s = &t[i];
+    if (s->state == kEmpty)
+      return for_insert ? (first_tomb ? first_tomb : s) : nullptr;
+    if (s->state == kTombstone) {
+      if (for_insert && !first_tomb) first_tomb = s;
+      continue;
+    }
+    if (memcmp(s->key, key, 16) == 0) return s;
+  }
+  return for_insert ? first_tomb : nullptr;
+}
+
+uint64_t align_up(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+}  // namespace
+
+extern "C" {
+
+// Create (or open existing) pool; returns opaque handle or null.
+void* rt_pool_create(const char* name, uint64_t slab_bytes,
+                     uint64_t table_slots) {
+  uint64_t table_bytes = align_up(table_slots * sizeof(Slot));
+  uint64_t hdr_bytes = align_up(sizeof(PoolHeader));
+  uint64_t total = hdr_bytes + table_bytes + slab_bytes;
+
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  bool created = fd >= 0;
+  if (!created) {
+    if (errno != EEXIST) return nullptr;
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+  } else if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd); shm_unlink(name); return nullptr;
+  }
+  if (!created) {
+    struct stat st;
+    if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+    total = (uint64_t)st.st_size;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  if (mem == MAP_FAILED) { close(fd); return nullptr; }
+
+  Pool* p = new Pool{fd, (uint8_t*)mem, total, (PoolHeader*)mem};
+  if (created) {
+    PoolHeader* h = p->hdr;
+    memset(h, 0, sizeof(PoolHeader));
+    h->total_bytes = total;
+    h->slab_off = hdr_bytes + table_bytes;
+    h->slab_bytes = slab_bytes;
+    h->table_off = hdr_bytes;
+    h->table_slots = table_slots;
+    memset(p->base + h->table_off, 0, table_bytes);
+    FreeBlock* fb = (FreeBlock*)(p->base + h->slab_off);
+    fb->size = slab_bytes;
+    fb->next = kNone;
+    h->free_head = 0;
+    pthread_mutexattr_t attr;
+    pthread_mutexattr_init(&attr);
+    pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&h->mutex, &attr);
+    pthread_mutexattr_destroy(&attr);
+    __sync_synchronize();
+    h->magic = kMagic;
+  } else {
+    // Spin briefly until the creator publishes the magic.
+    for (int i = 0; i < 100000 && p->hdr->magic != kMagic; i++)
+      usleep(10);
+    if (p->hdr->magic != kMagic) {
+      munmap(mem, total); close(fd); delete p; return nullptr;
+    }
+  }
+  return p;
+}
+
+void* rt_pool_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+  void* mem = mmap(nullptr, (size_t)st.st_size,
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) { close(fd); return nullptr; }
+  Pool* p = new Pool{fd, (uint8_t*)mem, (uint64_t)st.st_size,
+                     (PoolHeader*)mem};
+  if (p->hdr->magic != kMagic) {
+    munmap(mem, (size_t)st.st_size); close(fd); delete p;
+    return nullptr;
+  }
+  return p;
+}
+
+// Reserve space for an object; returns ABSOLUTE offset of its payload
+// within the mapping, or ~0 on full/duplicate.
+uint64_t rt_pool_alloc(void* pool, const uint8_t* key, uint64_t size) {
+  Pool* p = (Pool*)pool;
+  uint64_t need = align_up(size + sizeof(uint64_t));  // size header
+  if (lock(p) != 0) return kNone;
+  Slot* existing = find_slot(p, key, false);
+  if (existing && existing->state != kTombstone) { unlock(p); return kNone; }
+  // First-fit scan.
+  uint64_t prev = kNone, cur = p->hdr->free_head;
+  uint8_t* slab = p->base + p->hdr->slab_off;
+  while (cur != kNone) {
+    FreeBlock* fb = (FreeBlock*)(slab + cur);
+    if (fb->size >= need) break;
+    prev = cur; cur = fb->next;
+  }
+  if (cur == kNone) { unlock(p); return kNone; }
+  FreeBlock* fb = (FreeBlock*)(slab + cur);
+  uint64_t remain = fb->size - need;
+  uint64_t next = fb->next;
+  if (remain >= sizeof(FreeBlock) + kAlign) {
+    FreeBlock* rest = (FreeBlock*)(slab + cur + need);
+    rest->size = remain;
+    rest->next = next;
+    next = cur + need;
+  } else {
+    need = fb->size;  // absorb the sliver
+  }
+  if (prev == kNone) p->hdr->free_head = next;
+  else ((FreeBlock*)(slab + prev))->next = next;
+
+  *(uint64_t*)(slab + cur) = need;  // block size header
+  Slot* s = find_slot(p, key, true);
+  if (!s) {  // table full: give the block back
+    FreeBlock* back = (FreeBlock*)(slab + cur);
+    back->size = need; back->next = p->hdr->free_head;
+    p->hdr->free_head = cur;
+    unlock(p);
+    return kNone;
+  }
+  memcpy(s->key, key, 16);
+  s->off = cur + sizeof(uint64_t);
+  s->size = size;
+  s->state = kAllocated;
+  s->pins = 0;
+  p->hdr->used_bytes += need;
+  p->hdr->n_objects += 1;
+  unlock(p);
+  return p->hdr->slab_off + cur + sizeof(uint64_t);
+}
+
+int rt_pool_seal(void* pool, const uint8_t* key) {
+  Pool* p = (Pool*)pool;
+  if (lock(p) != 0) return -1;
+  Slot* s = find_slot(p, key, false);
+  int rc = -1;
+  if (s && s->state == kAllocated) { s->state = kSealed; rc = 0; }
+  unlock(p);
+  return rc;
+}
+
+// Absolute payload offset + size of a SEALED object; ~0 if absent.
+uint64_t rt_pool_lookup(void* pool, const uint8_t* key, uint64_t* size) {
+  Pool* p = (Pool*)pool;
+  if (lock(p) != 0) return kNone;
+  Slot* s = find_slot(p, key, false);
+  uint64_t off = kNone;
+  if (s && s->state == kSealed) { off = p->hdr->slab_off + s->off; *size = s->size; }
+  unlock(p);
+  return off;
+}
+
+namespace {
+void free_block_locked(Pool* p, Slot* s);
+void clear_tombstones_locked(Pool* p, Slot* s);
+}
+
+int rt_pool_delete(void* pool, const uint8_t* key) {
+  Pool* p = (Pool*)pool;
+  if (lock(p) != 0) return -1;
+  Slot* s = find_slot(p, key, false);
+  if (!s || s->state == kTombstone || s->state == kEmpty) {
+    unlock(p); return -1;
+  }
+  if (s->state == kAllocated) {
+    // A writer is (or was) mid-copy into this block: freeing it would
+    // let the bytes be recycled under the write.  Refuse; a crashed
+    // writer leaks one block, which is the safe failure.
+    unlock(p); return -2;
+  }
+  if (s->pins > 0) {
+    // Readers hold the payload: defer the free to the last unpin.
+    s->state = kPendingDelete;
+    unlock(p); return 0;
+  }
+  free_block_locked(p, s);
+  unlock(p);
+  return 0;
+}
+
+namespace {
+void free_block_locked(Pool* p, Slot* s) {
+  uint8_t* slab = p->base + p->hdr->slab_off;
+  uint64_t blk = s->off - sizeof(uint64_t);
+  uint64_t bsize = *(uint64_t*)(slab + blk);
+  // Address-ordered insert with neighbor coalescing.
+  uint64_t prev = kNone, cur = p->hdr->free_head;
+  while (cur != kNone && cur < blk) {
+    prev = cur; cur = ((FreeBlock*)(slab + cur))->next;
+  }
+  FreeBlock* nb = (FreeBlock*)(slab + blk);
+  nb->size = bsize;
+  nb->next = cur;
+  if (prev == kNone) p->hdr->free_head = blk;
+  else ((FreeBlock*)(slab + prev))->next = blk;
+  // Coalesce with next.
+  if (cur != kNone && blk + nb->size == cur) {
+    FreeBlock* cb = (FreeBlock*)(slab + cur);
+    nb->size += cb->size;
+    nb->next = cb->next;
+  }
+  // Coalesce with prev.
+  if (prev != kNone) {
+    FreeBlock* pb = (FreeBlock*)(slab + prev);
+    if (prev + pb->size == blk) {
+      pb->size += nb->size;
+      pb->next = nb->next;
+    }
+  }
+  p->hdr->used_bytes -= bsize;
+  p->hdr->n_objects -= 1;
+  s->state = kTombstone;
+  s->pins = 0;
+  clear_tombstones_locked(p, s);
+}
+
+// If the probe chain ends right after this slot, convert the trailing
+// run of tombstones back to empty — keeps miss lookups O(chain), not
+// O(table), under sustained churn.
+void clear_tombstones_locked(Pool* p, Slot* s) {
+  Slot* t = table(p);
+  uint64_t n = p->hdr->table_slots;
+  uint64_t i = (uint64_t)(s - t);
+  if (t[(i + 1) % n].state != kEmpty) return;
+  while (t[i].state == kTombstone) {
+    t[i].state = kEmpty;
+    i = (i + n - 1) % n;
+  }
+}
+}  // namespace
+
+// Lookup AND pin in one critical section; the payload cannot be freed
+// until rt_pool_unpin.  Returns the absolute offset or ~0.
+uint64_t rt_pool_pin(void* pool, const uint8_t* key, uint64_t* size) {
+  Pool* p = (Pool*)pool;
+  if (lock(p) != 0) return kNone;
+  Slot* s = find_slot(p, key, false);
+  uint64_t off = kNone;
+  if (s && s->state == kSealed) {
+    s->pins += 1;
+    off = p->hdr->slab_off + s->off;
+    *size = s->size;
+  }
+  unlock(p);
+  return off;
+}
+
+int rt_pool_unpin(void* pool, const uint8_t* key) {
+  Pool* p = (Pool*)pool;
+  if (lock(p) != 0) return -1;
+  Slot* s = find_slot(p, key, false);
+  int rc = -1;
+  if (s && (s->state == kSealed || s->state == kPendingDelete) &&
+      s->pins > 0) {
+    s->pins -= 1;
+    rc = 0;
+    if (s->pins == 0 && s->state == kPendingDelete)
+      free_block_locked(p, s);
+  }
+  unlock(p);
+  return rc;
+}
+
+int rt_pool_contains(void* pool, const uint8_t* key) {
+  Pool* p = (Pool*)pool;
+  if (lock(p) != 0) return 0;
+  Slot* s = find_slot(p, key, false);
+  int rc = (s && s->state == kSealed) ? 1 : 0;
+  unlock(p);
+  return rc;
+}
+
+void rt_pool_stats(void* pool, uint64_t* used, uint64_t* capacity,
+                   uint64_t* n_objects) {
+  Pool* p = (Pool*)pool;
+  if (lock(p) != 0) { *used = *capacity = *n_objects = 0; return; }
+  *used = p->hdr->used_bytes;
+  *capacity = p->hdr->slab_bytes;
+  *n_objects = p->hdr->n_objects;
+  unlock(p);
+}
+
+void rt_pool_close(void* pool) {
+  Pool* p = (Pool*)pool;
+  munmap(p->base, p->map_bytes);
+  close(p->fd);
+  delete p;
+}
+
+int rt_pool_unlink(const char* name) { return shm_unlink(name); }
+
+}  // extern "C"

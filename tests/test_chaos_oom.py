@@ -76,7 +76,8 @@ def test_chaos_node_killer_tasks_still_complete():
         cluster.add_node(num_cpus=2)
         ray_tpu.init(address=cluster.address)
 
-        killer = NodeKiller(cluster, interval_s=3.0, seed=1).start()
+        killer = NodeKiller(cluster, interval_s=3.0, seed=1,
+                            max_kills=1).start()
 
         @ray_tpu.remote(max_retries=8)
         def work(i):
@@ -84,7 +85,7 @@ def test_chaos_node_killer_tasks_still_complete():
             return i * i
 
         refs = [work.remote(i) for i in range(24)]
-        out = ray_tpu.get(refs, timeout=240)
+        out = ray_tpu.get(refs, timeout=300)
         assert out == [i * i for i in range(24)]
         assert killer.kills, "chaos killer never fired"
     finally:
@@ -104,7 +105,7 @@ def test_chaos_worker_killer_with_retries():
         ray_tpu.init(mode="cluster", num_cpus=2)
         rt = _rm.get_runtime()
         killer = WorkerKiller(rt.agent_call, interval_s=0.7,
-                              seed=3).start()
+                              seed=3, max_kills=4).start()
 
         @ray_tpu.remote(max_retries=10)
         def slowish(i):
