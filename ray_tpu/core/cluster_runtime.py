@@ -1102,48 +1102,69 @@ class ClusterRuntime(BaseRuntime):
         deadline = time.monotonic() + timeout if timeout is not None else None
         ready: List[ObjectRef] = []
         not_ready = list(refs)
+        delay = 0.005
         while len(ready) < num_returns:
             progressed = False
+            # Local checks first (memory store / owned-pending) — free.
+            foreign: List[ObjectRef] = []
             for r in list(not_ready):
-                if self._ready_nowait(r):
+                ok, _ = self.memory.get_nowait(r.id)
+                if ok:
                     ready.append(r)
                     not_ready.remove(r)
                     progressed = True
                     if len(ready) >= num_returns:
-                        break  # never exceed num_returns
+                        break
+                    continue
+                with self._pending_lock:
+                    if r.id not in self._pending_returns:
+                        foreign.append(r)
+            # Foreign refs: ONE bulk directory probe per pass instead of
+            # two RPCs per ref per poll (round-1 weak item: O(refs x
+            # polls) controller load from any wait loop).  The local
+            # agent is the fallback source of truth for copies whose
+            # controller publication failed or lagged.
+            if foreign and len(ready) < num_returns:
+                oids = [r.id for r in foreign]
+                try:
+                    res = self.io.run(self._ctl.call(
+                        "locate_objects", {"object_ids": oids}),
+                        timeout=5.0)
+                except Exception:
+                    res = {}
+                missing = [o for o in oids if not res.get(o)]
+                if missing:
+                    try:
+                        local = self.io.run(self._agent.call(
+                            "objects_exist", {"object_ids": missing}),
+                            timeout=5.0)
+                        res = {**local, **{k: v for k, v in res.items()
+                                           if v}}
+                    except Exception:
+                        pass
+                for r in foreign:
+                    if res.get(r.id):
+                        ready.append(r)
+                        if r in not_ready:
+                            not_ready.remove(r)
+                        progressed = True
+                        if len(ready) >= num_returns:
+                            break
             if len(ready) >= num_returns:
                 break
             if deadline is not None and time.monotonic() >= deadline:
                 break
             if not progressed:
-                time.sleep(0.005)
+                time.sleep(delay)
+                delay = min(delay * 1.5, 0.05)  # back off when idle
+            else:
+                delay = 0.005
         if fetch_local and ready:
             try:
                 self.get(ready, timeout=None)
             except TaskError:
                 pass  # errored objects still count as ready
         return ready, not_ready
-
-    def _ready_nowait(self, ref: ObjectRef) -> bool:
-        ok, _ = self.memory.get_nowait(ref.id)
-        if ok:
-            return True
-        with self._pending_lock:
-            if ref.id in self._pending_returns:
-                return False
-        # Foreign ref: ask the local agent / directory.
-        try:
-            r = self.io.run(self._agent.call("object_exists",
-                                             {"object_id": ref.id}),
-                            timeout=5.0)
-            if r.get("exists"):
-                return True
-            loc = self.io.run(self._ctl.call("locate_object",
-                                             {"object_id": ref.id}),
-                              timeout=5.0)
-            return loc is not None and bool(loc["nodes"])
-        except Exception:
-            return False
 
     def cancel(self, ref: ObjectRef, force: bool) -> None:
         """Cancel the task producing ``ref`` (ref: core_worker.cc

@@ -106,6 +106,7 @@ class Controller:
             "lookup_named_actor", "kill_actor", "worker_exited",
             "kv_put", "kv_get", "kv_del", "kv_keys", "kv_append", "kv_list",
             "publish_locations", "remove_locations", "locate_object",
+            "locate_objects",
             "free_object", "owner_release", "add_borrower",
             "remove_borrower", "link_induced_borrows",
             "poll_events", "register_job", "finish_job",
@@ -502,6 +503,15 @@ class Controller:
                 if not info["nodes"]:
                     self._drop_if_idle(oid)  # keep borrower/owner state
         return {"ok": True}
+
+    async def locate_objects(self, p):
+        """Bulk existence probe (wait() fast path): one RPC answers
+        readiness for a whole ref list instead of two per ref."""
+        out = {}
+        for oid in p["object_ids"]:
+            info = self.object_dir.get(oid)
+            out[oid] = bool(info and info["nodes"])
+        return out
 
     async def locate_object(self, p):
         info = self.object_dir.get(p["object_id"])

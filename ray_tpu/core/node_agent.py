@@ -142,7 +142,7 @@ class NodeAgent:
             "task_blocked", "task_unblocked",
             "register_object", "pull_object", "fetch_raw", "fetch_chunk",
             "delete_object",
-            "object_exists", "store_stats",
+            "object_exists", "objects_exist", "store_stats",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "restart_actor", "kill_worker", "report_actor_failure",
             "drain", "shutdown", "ping", "node_info", "list_workers",
@@ -891,6 +891,12 @@ class NodeAgent:
         except RpcError:
             pass
         return {"ok": True}
+
+    async def objects_exist(self, p):
+        """Bulk local-directory probe (wait() fallback for objects whose
+        controller publication failed or lagged)."""
+        return {oid: self.directory.lookup(oid) is not None
+                for oid in p["object_ids"]}
 
     async def object_exists(self, p):
         ent = self.directory.lookup(p["object_id"])

@@ -17,3 +17,6 @@ from .session import (checkpoint_dir, get_checkpoint,  # noqa: F401
 from .trainer import (DataParallelTrainer, JaxTrainer,  # noqa: F401
                       TorchTrainer)
 from .worker_group import WorkerGroup  # noqa: F401
+from .v2 import (ControllerState, ElasticScalingPolicy,  # noqa: F401
+                 FailureDecision, FailurePolicy, FixedScalingPolicy,
+                 JaxTrainerV2, TrainControllerV2)

@@ -66,31 +66,18 @@ class BaseTrainer:
 
     # ------------------------------------------------------------------ fit
     def fit(self) -> Result:
-        run_dir = self.run_config.resolved_storage_path()
-        ckpt_cfg = self.run_config.checkpoint_config
-        manager = CheckpointManager(
-            run_dir, num_to_keep=ckpt_cfg.num_to_keep,
-            score_attribute=ckpt_cfg.checkpoint_score_attribute,
-            score_order=ckpt_cfg.checkpoint_score_order)
-        start_ckpt = self.resume_from_checkpoint or \
-            CheckpointManager.find_latest_in(run_dir)
-        failures_left = self.run_config.failure_config.max_failures
-        history: list = []
-        while True:
-            try:
-                final = self._run_attempt(manager, start_ckpt, history)
-                return Result(metrics=final, checkpoint=manager.latest(),
-                              path=run_dir, metrics_history=history)
-            except WorkerGroupError as e:
-                if failures_left == 0:
-                    return Result(metrics=history[-1]["metrics"]
-                                  if history else {},
-                                  checkpoint=manager.latest(),
-                                  path=run_dir, error=e.cause,
-                                  metrics_history=history)
-                if failures_left > 0:
-                    failures_left -= 1
-                start_ckpt = manager.latest()  # elastic restart point
+        """v1 fit == the v2 controller with a fixed gang size; one
+        retry/resume/checkpoint loop lives in v2.TrainControllerV2."""
+        from .v2 import (FailurePolicy, FixedScalingPolicy,
+                         TrainControllerV2)
+
+        controller = TrainControllerV2(
+            self,
+            scaling_policy=FixedScalingPolicy(
+                self.scaling_config.num_workers),
+            failure_policy=FailurePolicy(
+                self.run_config.failure_config.max_failures))
+        return controller.fit()
 
     # -------------------------------------------------------------- attempt
     def _run_attempt(self, manager: CheckpointManager,

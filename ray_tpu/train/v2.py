@@ -1,0 +1,190 @@
+"""Train v2 — elastic controller with pluggable scaling/failure policies.
+
+Role-equivalent to the reference's Train v2 control loop (ref:
+train/v2/_internal/execution/controller.py:73 TrainController state
+machine, loop at :276,325, with pluggable ScalingPolicy/FailurePolicy).
+TPU framing: the worker gang IS one SPMD program, so elasticity is
+whole-group — each attempt re-decides the gang size from what the
+cluster can actually schedule, re-initializes jax.distributed at that
+size, and resumes from the latest checkpoint (a TPU slice is the atomic
+failure domain; per-worker patching is not meaningful under SPMD).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, replace
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+
+from .checkpoint import CheckpointManager
+from .config import Result
+from .trainer import BaseTrainer, JaxBackend
+from .worker_group import WorkerGroupError
+
+
+class ControllerState(str, Enum):
+    INITIALIZING = "INITIALIZING"
+    SCHEDULING = "SCHEDULING"
+    RUNNING = "RUNNING"
+    RESTARTING = "RESTARTING"
+    RESIZING = "RESIZING"
+    ERRORED = "ERRORED"
+    FINISHED = "FINISHED"
+
+
+class ScalingPolicy:
+    """Decides the gang size for the next attempt."""
+
+    def workers_for_attempt(self, attempt: int) -> int:
+        raise NotImplementedError
+
+
+@dataclass
+class FixedScalingPolicy(ScalingPolicy):
+    num_workers: int = 1
+
+    def workers_for_attempt(self, attempt: int) -> int:
+        return self.num_workers
+
+
+@dataclass
+class ElasticScalingPolicy(ScalingPolicy):
+    """Size the gang to what the cluster can schedule NOW, clamped to
+    [min_workers, max_workers] (ref: v2 ScalingPolicy elastic
+    recovery)."""
+
+    min_workers: int = 1
+    max_workers: int = 8
+    cpus_per_worker: float = 1.0
+
+    def workers_for_attempt(self, attempt: int) -> int:
+        try:
+            avail = ray_tpu.available_resources().get("CPU", 0.0)
+        except Exception:
+            avail = 0.0
+        fit = int(avail // max(self.cpus_per_worker, 1e-9))
+        return max(self.min_workers, min(self.max_workers, fit))
+
+
+class FailureDecision(str, Enum):
+    RETRY = "RETRY"
+    RAISE = "RAISE"
+
+
+@dataclass
+class FailurePolicy:
+    """ref: v2 FailurePolicy — bounded retries by default."""
+
+    max_failures: int = 3
+
+    def decide(self, failure_count: int,
+               error: BaseException) -> FailureDecision:
+        if self.max_failures < 0:  # infinite retries
+            return FailureDecision.RETRY
+        return (FailureDecision.RETRY
+                if failure_count <= self.max_failures
+                else FailureDecision.RAISE)
+
+
+class TrainControllerV2:
+    """Drives attempts of a BaseTrainer-compatible trainer through the
+    v2 state machine; exposes the state transitions for observability
+    (ref: controller.py TrainControllerStateType)."""
+
+    def __init__(self, trainer: BaseTrainer,
+                 scaling_policy: Optional[ScalingPolicy] = None,
+                 failure_policy: Optional[FailurePolicy] = None):
+        self.trainer = trainer
+        self.scaling_policy = scaling_policy or FixedScalingPolicy(
+            trainer.scaling_config.num_workers)
+        self.failure_policy = failure_policy or FailurePolicy(
+            trainer.run_config.failure_config.max_failures)
+        self.state_history: List[Dict[str, Any]] = []
+        self.attempt_sizes: List[int] = []
+
+    def _transition(self, state: ControllerState, **info) -> None:
+        self.state_history.append(
+            {"state": state.value, "ts": time.time(), **info})
+
+    def fit(self) -> Result:
+        self._transition(ControllerState.INITIALIZING)
+        run_dir = self.trainer.run_config.resolved_storage_path()
+        ckpt_cfg = self.trainer.run_config.checkpoint_config
+        manager = CheckpointManager(
+            run_dir, num_to_keep=ckpt_cfg.num_to_keep,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            score_order=ckpt_cfg.checkpoint_score_order)
+        start_ckpt = self.trainer.resume_from_checkpoint or \
+            CheckpointManager.find_latest_in(run_dir)
+        history: List[Dict] = []
+        failures = 0
+        attempt = 0
+        while True:
+            self._transition(ControllerState.SCHEDULING,
+                             attempt=attempt)
+            size = max(1, self.scaling_policy.workers_for_attempt(
+                attempt))
+            prev = self.trainer.scaling_config.num_workers
+            if size != prev and attempt > 0:
+                self._transition(ControllerState.RESIZING,
+                                 from_workers=prev, to_workers=size)
+            self.trainer.scaling_config = replace(
+                self.trainer.scaling_config, num_workers=size)
+            self.attempt_sizes.append(size)
+            self._transition(ControllerState.RUNNING, workers=size)
+            try:
+                final = self.trainer._run_attempt(manager, start_ckpt,
+                                                  history)
+                self._transition(ControllerState.FINISHED)
+                return Result(metrics=final,
+                              checkpoint=manager.latest(),
+                              path=run_dir, metrics_history=history)
+            except WorkerGroupError as e:
+                failures += 1
+                decision = self.failure_policy.decide(failures, e.cause)
+                if decision == FailureDecision.RAISE:
+                    self._transition(ControllerState.ERRORED,
+                                     error=repr(e.cause))
+                    return Result(
+                        metrics=history[-1]["metrics"] if history
+                        else {},
+                        checkpoint=manager.latest(), path=run_dir,
+                        error=e.cause, metrics_history=history)
+                self._transition(ControllerState.RESTARTING,
+                                 failures=failures)
+                start_ckpt = manager.latest()
+                attempt += 1
+
+
+class JaxTrainerV2:
+    """User-facing v2 trainer: JaxTrainer semantics under the elastic
+    controller."""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[Dict] = None,
+                 scaling_policy: Optional[ScalingPolicy] = None,
+                 failure_policy: Optional[FailurePolicy] = None,
+                 run_config=None, datasets=None,
+                 resume_from_checkpoint=None, backend_cls=JaxBackend):
+        from .config import ScalingConfig
+
+        trainer = BaseTrainer(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=run_config, datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint)
+        trainer.backend_cls = backend_cls
+        self.controller = TrainControllerV2(
+            trainer, scaling_policy=scaling_policy,
+            failure_policy=failure_policy)
+
+    def fit(self) -> Result:
+        return self.controller.fit()
+
+    @property
+    def state_history(self) -> List[Dict[str, Any]]:
+        return self.controller.state_history

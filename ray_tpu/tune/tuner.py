@@ -42,23 +42,30 @@ class _TrialActor:
     def __init__(self):
         self.reports: List[Dict] = []
         self.iteration = 0
+        self.checkpoint: Any = None
 
-    def run(self, fn_payload: bytes, config: Dict):
+    def run(self, fn_payload: bytes, config: Dict,
+            checkpoint: Any = None, start_iteration: int = 0):
         import cloudpickle
 
         from ray_tpu.tune import tuner as tuner_mod
 
         fn = cloudpickle.loads(fn_payload)
+        self.checkpoint = checkpoint
+        self.iteration = start_iteration
         tuner_mod._trial_session = self
         try:
             return fn(config)
         finally:
             tuner_mod._trial_session = None
 
-    def _record(self, metrics: Dict):
+    def _record(self, metrics: Dict, checkpoint: Any = None):
         self.iteration += 1
         row = dict(metrics)
         row.setdefault("training_iteration", self.iteration)
+        if checkpoint is not None:
+            self.checkpoint = checkpoint
+            row["__checkpoint__"] = checkpoint
         self.reports.append(row)
 
     def poll(self):
@@ -67,11 +74,20 @@ class _TrialActor:
 
 
 def report(metrics: Dict[str, Any], checkpoint=None) -> None:
-    """Called inside a trial fn (ref: tune.report / session.report)."""
-    del checkpoint  # checkpointing rides train.report inside trainers
+    """Called inside a trial fn (ref: tune.report / session.report).
+    ``checkpoint`` (any picklable value) becomes the trial's restore
+    point — PBT exploits clone it into other trials."""
     if _trial_session is None:
         raise RuntimeError("tune.report() called outside a trial")
-    _trial_session._record(metrics)
+    _trial_session._record(metrics, checkpoint)
+
+
+def get_checkpoint() -> Any:
+    """Inside a trial fn: the checkpoint to resume from (None on a
+    fresh start; ref: tune.get_checkpoint)."""
+    if _trial_session is None:
+        raise RuntimeError("tune.get_checkpoint() outside a trial")
+    return getattr(_trial_session, "checkpoint", None)
 
 
 @dataclass
@@ -83,6 +99,8 @@ class Trial:
     status: str = "PENDING"   # PENDING|RUNNING|TERMINATED|STOPPED|ERROR
     history: List[Dict] = field(default_factory=list)
     error: Optional[BaseException] = None
+    checkpoint: Any = None     # latest tune.report(checkpoint=...) value
+    num_restarts: int = 0      # PBT exploit restarts
 
     def last_metrics(self) -> Dict:
         return self.history[-1] if self.history else {}
@@ -192,17 +210,51 @@ class Tuner:
             # Poll reports and completion.
             done_refs, _ = ray_tpu.wait([t.run_ref for t in running],
                                         num_returns=1, timeout=0.2)
+            pop_hook = getattr(scheduler, "on_population_result", None)
             for t in list(running):
+                exploit_decision = None
+                stopped = False
+                # Consume the WHOLE batch (poll() already popped it from
+                # the actor) before acting on any decision — dropping
+                # the tail would lose metrics and checkpoints forever.
                 for row in ray_tpu.get(t.actor.poll.remote()):
+                    if "__checkpoint__" in row:
+                        t.checkpoint = row.pop("__checkpoint__")
                     t.history.append(row)
+                    if stopped or exploit_decision is not None:
+                        continue
                     decision = scheduler.on_result(t.trial_id, row)
                     if decision in (STOP, COMPLETE) and \
                             t.status == "RUNNING":
                         t.status = ("STOPPED" if decision == STOP
                                     else "TERMINATED")
-                        ray_tpu.kill(t.actor)
-                        running.remove(t)
-                        break
+                        stopped = True
+                        continue
+                    if pop_hook is not None and t.status == "RUNNING":
+                        pdec = pop_hook(t, row, trials)
+                        if isinstance(pdec, dict) and "exploit" in pdec:
+                            exploit_decision = pdec
+                if stopped:
+                    ray_tpu.kill(t.actor)
+                    running.remove(t)
+                    continue
+                if exploit_decision is not None:
+                    # PBT: adopt the source's checkpoint + mutated
+                    # config and restart the trial, continuing the
+                    # iteration clock so perturbation windows and rung
+                    # milestones stay monotonic.
+                    source = exploit_decision["exploit"]
+                    ray_tpu.kill(t.actor)
+                    t.config = exploit_decision["config"]
+                    t.checkpoint = source.checkpoint
+                    t.num_restarts += 1
+                    last_iter = max(
+                        (r.get("training_iteration", 0)
+                         for r in t.history), default=0)
+                    t.actor = _TrialActor.remote()
+                    t.run_ref = t.actor.run.remote(
+                        payload, t.config, t.checkpoint, last_iter)
+                    continue
                 if t.status != "RUNNING":
                     continue
                 if t.run_ref in done_refs:
@@ -211,6 +263,9 @@ class Tuner:
                         # Final poll for reports emitted just before exit.
                         try:
                             for row in ray_tpu.get(t.actor.poll.remote()):
+                                if "__checkpoint__" in row:
+                                    t.checkpoint = \
+                                        row.pop("__checkpoint__")
                                 t.history.append(row)
                         except Exception:
                             pass

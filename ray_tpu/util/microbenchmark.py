@@ -1,0 +1,125 @@
+"""Core-runtime microbenchmarks: task/actor/object throughput.
+
+Role-equivalent to the reference's perf microbenchmark (ref:
+python/ray/_private/ray_perf.py:93 + release/microbenchmark/) — the
+regression canary for the control plane: schedulers, RPC, and the
+object plane, independent of any ML workload.
+
+Run: ``python -m ray_tpu.util.microbenchmark [--quick]``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict, List
+
+
+def _timeit(name: str, fn: Callable[[], int],
+            results: List[Dict[str, Any]]) -> None:
+    t0 = time.perf_counter()
+    n = fn()
+    dt = time.perf_counter() - t0
+    results.append({"benchmark": name, "per_sec": round(n / dt, 1),
+                    "total": n, "seconds": round(dt, 3)})
+
+
+def run(quick: bool = False) -> List[Dict[str, Any]]:
+    import numpy as np
+
+    import ray_tpu
+
+    scale = 0.2 if quick else 1.0
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    results: List[Dict[str, Any]] = []
+
+    n = max(int(100 * scale), 10)
+
+    def seq_tasks():
+        for _ in range(n):
+            ray_tpu.get(noop.remote(), timeout=60)
+        return n
+
+    _timeit("tasks_sequential", seq_tasks, results)
+
+    m = max(int(300 * scale), 20)
+
+    def batch_tasks():
+        ray_tpu.get([noop.remote() for _ in range(m)], timeout=120)
+        return m
+
+    _timeit("tasks_batch", batch_tasks, results)
+
+    actor = Counter.remote()
+    ray_tpu.get(actor.inc.remote(), timeout=60)  # warm
+
+    def seq_actor_calls():
+        for _ in range(n):
+            ray_tpu.get(actor.inc.remote(), timeout=60)
+        return n
+
+    _timeit("actor_calls_sequential", seq_actor_calls, results)
+
+    def batch_actor_calls():
+        ray_tpu.get([actor.inc.remote() for _ in range(m)], timeout=120)
+        return m
+
+    _timeit("actor_calls_batch", batch_actor_calls, results)
+
+    small = {"x": 1}
+
+    def put_get_small():
+        for _ in range(n):
+            ray_tpu.get(ray_tpu.put(small), timeout=60)
+        return n
+
+    _timeit("put_get_small", put_get_small, results)
+
+    big = np.zeros((1024, 1024), np.float32)  # 4 MB
+    k = max(int(20 * scale), 4)
+
+    def put_get_4mb():
+        for _ in range(k):
+            ray_tpu.get(ray_tpu.put(big), timeout=60)
+        return k
+
+    _timeit("put_get_4mb", put_get_4mb, results)
+
+    ray_tpu.kill(actor)
+    return results
+
+
+def main() -> None:
+    import argparse
+
+    import ray_tpu
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+    owns = not ray_tpu.is_initialized()
+    if owns:
+        ray_tpu.init(mode="cluster", num_cpus=2)
+    try:
+        for row in run(quick=args.quick):
+            print(json.dumps(row))
+    finally:
+        if owns:
+            ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Microbenchmark smoke + TPU chip-ledger isolation under contention.
+
+Ref: ray_perf.py:93 (microbenchmarks) and the round-1 weak item: no
+test asserted two concurrent TPU leases receive disjoint
+TPU_VISIBLE_CHIPS (node_agent chip ledger).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_microbenchmark_smoke():
+    ray_tpu.init(mode="cluster", num_cpus=2)
+    try:
+        from ray_tpu.util.microbenchmark import run
+
+        rows = run(quick=True)
+        names = {r["benchmark"] for r in rows}
+        assert {"tasks_sequential", "tasks_batch",
+                "actor_calls_sequential", "actor_calls_batch",
+                "put_get_small", "put_get_4mb"} <= names
+        assert all(r["per_sec"] > 0 for r in rows)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_concurrent_tpu_leases_get_disjoint_chips():
+    """Two tasks each holding TPU:2 concurrently must see disjoint
+    TPU_VISIBLE_CHIPS drawn from the host ledger of 4 chips."""
+    os.environ["RT_TPU_CHIPS_PER_HOST"] = "4"
+    try:
+        ray_tpu.init(mode="cluster", num_cpus=2, num_tpus=4)
+
+        @ray_tpu.remote(num_tpus=2, num_cpus=0)
+        def hold(sync_name):
+            import time as _t
+
+            import ray_tpu as rt
+
+            chips = os.environ["TPU_VISIBLE_CHIPS"]
+            gate = rt.get_actor(sync_name)
+            rt.get(gate.arrive.remote(chips))
+            # Stay leased until both tasks have reported, so the leases
+            # genuinely overlap.
+            deadline = _t.time() + 30
+            while _t.time() < deadline:
+                if rt.get(gate.count.remote()) >= 2:
+                    return chips
+                _t.sleep(0.1)
+            return chips
+
+        @ray_tpu.remote
+        class Gate:
+            def __init__(self):
+                self.seen = []
+
+            def arrive(self, chips):
+                self.seen.append(chips)
+                return len(self.seen)
+
+            def count(self):
+                return len(self.seen)
+
+        gate = Gate.options(name="chip_gate").remote()
+        ray_tpu.get(gate.count.remote(), timeout=60)
+        a, b = ray_tpu.get([hold.remote("chip_gate"),
+                            hold.remote("chip_gate")], timeout=120)
+        set_a = set(a.split(","))
+        set_b = set(b.split(","))
+        assert len(set_a) == 2 and len(set_b) == 2
+        assert not (set_a & set_b), (a, b)
+        assert set_a | set_b <= {"0", "1", "2", "3"}
+    finally:
+        os.environ.pop("RT_TPU_CHIPS_PER_HOST", None)
+        ray_tpu.shutdown()
