@@ -196,6 +196,12 @@ class ActorMethod:
         return self._handle._submit_method(self._name, args, kwargs,
                                            self._num_returns)
 
+    def bind(self, *args):
+        """Build a DAG node from this method (ref: dag_node bind)."""
+        from ..dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args)
+
 
 class ActorHandle:
     """Client-side handle to a live actor; picklable into tasks."""
@@ -254,6 +260,15 @@ class ActorClass:
     """A class decorated with ``@remote``; instantiate via ``.remote(...)``."""
 
     def __init__(self, cls, options: Dict[str, Any]):
+        # Inject the compiled-DAG resident loop as an actor method (ref:
+        # compiled DAGs' do_exec_tasks entrypoint on every actor).
+        if not hasattr(cls, "dag_exec_loop"):
+            from ..dag import _dag_exec_loop
+
+            try:
+                cls.dag_exec_loop = _dag_exec_loop
+            except (AttributeError, TypeError):
+                pass  # frozen/extension classes opt out of DAG support
         self._cls = cls
         self._options = options
         self._blob: Optional[bytes] = None

@@ -1,0 +1,305 @@
+"""Compiled DAGs: actor-method pipelines over pre-negotiated channels.
+
+Role-equivalent to the reference's accelerated DAGs (ref:
+python/ray/dag/compiled_dag_node.py + dag_node.py bind API): build a
+graph of actor method calls with ``.bind()``, then either interpret it
+per call (``execute`` = one actor RPC per node) or COMPILE it —
+every actor starts a resident execution loop reading its input channel,
+invoking the bound method, and writing its output channel, so a steady-
+state invocation costs channel hops (shm memcpys) instead of
+submit/lease/push RPC rounds per node.
+
+TPU framing: compiled DAGs pipeline HOST work between actors (stage
+pre/post-processing, parameter servers, env loops).  Chip-to-chip
+tensors do not ride DAG channels — device communication belongs inside
+the jitted SPMD program over ICI (ref: our parallel/ stack), which is
+why the reference's NCCL p2p channel type has no analogue here.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ..experimental.channel import Channel
+
+__all__ = ["InputNode", "DAGNode", "ClassMethodNode", "CompiledDAG",
+           "bind"]
+
+
+class DAGNode:
+    def execute(self, *args):
+        """Interpret the whole DAG once (no compilation)."""
+        return _interpret(self, args)
+
+    def experimental_compile(self, **kwargs) -> "CompiledDAG":
+        return CompiledDAG(self, **kwargs)
+
+
+class InputNode(DAGNode):
+    """The DAG's input placeholder (ref: dag/input_node.py).  Usable as
+    a context manager for parity with the reference API."""
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, actor, method_name: str, args: tuple):
+        self.actor = actor
+        self.method_name = method_name
+        self.args = args
+
+    def upstream(self) -> List[DAGNode]:
+        return [a for a in self.args if isinstance(a, DAGNode)]
+
+
+def bind(actor, method_name: str, *args) -> ClassMethodNode:
+    """Functional bind: ``bind(actor, "method", upstream_or_value)``.
+    (``actor.method.bind(...)`` sugar is attached to ActorMethod.)"""
+    return ClassMethodNode(actor, method_name, args)
+
+
+def _interpret(node: DAGNode, dag_input: tuple) -> Any:
+    memo: Dict[int, Any] = {}
+
+    def ev(n):
+        if isinstance(n, InputNode):
+            return dag_input[0] if len(dag_input) == 1 else dag_input
+        if id(n) in memo:
+            return memo[id(n)]
+        assert isinstance(n, ClassMethodNode)
+        args = [ev(a) if isinstance(a, DAGNode) else a for a in n.args]
+        out = ray_tpu.get(
+            getattr(n.actor, n.method_name).remote(*args))
+        memo[id(n)] = out
+        return out
+
+    return ev(node)
+
+
+def _dag_exec_loop(self, method_name: str, in_channels: List[Channel],
+                   const_args: List[Any], arg_slots: List[int],
+                   out_channel: Channel) -> str:
+    """Runs INSIDE the actor (shipped as a normal method call with
+    max_concurrency headroom): read upstream channels, apply the bound
+    method, write downstream; a __dag_stop__ sentinel ends the loop
+    (ref: compiled_dag_node.py do_exec_tasks)."""
+    def push(value) -> bool:
+        # Bounded write: if downstream stops reading (torn down or
+        # wedged) the loop must eventually exit rather than occupy the
+        # actor slot forever.
+        from ..experimental.channel import ChannelFull
+
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            try:
+                out_channel.write(value, timeout=5.0)
+                return True
+            except ChannelFull:
+                continue
+            except Exception:
+                return False
+        return False
+
+    while True:
+        vals = [ch.read() for ch in in_channels]
+        if any(isinstance(v, _Stop) for v in vals):
+            push(_Stop())
+            return "stopped"
+        err = next((v for v in vals if isinstance(v, _Err)), None)
+        if err is not None:
+            # Upstream failed: forward, don't feed the error object to
+            # the bound method as if it were data.
+            if not push(err):
+                return "abandoned"
+            continue
+        args = list(const_args)
+        for slot, v in zip(arg_slots, vals):
+            args[slot] = v
+        try:
+            out = getattr(self, method_name)(*args)
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            if not push(_Err(e)):
+                return "abandoned"
+            continue
+        if not push(out):
+            return "abandoned"
+
+
+class _Stop:
+    pass
+
+
+class _Err:
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+class CompiledDAG:
+    """Static linear/tree pipelines over SPSC channels.
+
+    Constraints (explicit, erroring early): one InputNode consumer
+    chain; each ClassMethodNode feeds exactly one downstream node (SPSC
+    channels); one terminal output.
+    """
+
+    def __init__(self, output_node: DAGNode, *,
+                 slot_bytes: int = 1 << 20, num_slots: int = 8,
+                 timeout: float = 120.0):
+        if not isinstance(output_node, ClassMethodNode):
+            raise TypeError("compile the terminal ClassMethodNode")
+        self._timeout = timeout
+        self._id = uuid.uuid4().hex[:10]
+        self._channels: List[Channel] = []
+        self._loops: List[Any] = []
+        self._torn_down = False
+        self._next_seq = 0
+        self._read_seq = 0
+        self._results: Dict[int, Any] = {}
+
+        # Topological walk; assign one output channel per node.
+        order: List[ClassMethodNode] = []
+        seen: Dict[int, int] = {}
+
+        def visit(n: DAGNode):
+            if isinstance(n, InputNode):
+                return
+            assert isinstance(n, ClassMethodNode), n
+            if id(n) in seen:
+                raise ValueError(
+                    "a compiled node may feed exactly one consumer "
+                    "(SPSC channels); use .execute() for DAGs with "
+                    "fan-out")
+            seen[id(n)] = 1
+            for up in n.upstream():
+                visit(up)
+            order.append(n)
+
+        visit(output_node)
+        for n in order:
+            if getattr(n.actor, "_max_concurrency", 1) < 2:
+                raise ValueError(
+                    f"actor hosting {n.method_name!r} needs "
+                    f"max_concurrency >= 2: the resident DAG loop "
+                    f"occupies one slot for the DAG's lifetime")
+
+        def make_channel(tag: str) -> Channel:
+            ch = Channel(f"rtdag_{self._id}_{tag}",
+                         slot_bytes=slot_bytes, num_slots=num_slots,
+                         create=True)
+            self._channels.append(ch)
+            return ch
+
+        try:
+            self._build(order, output_node, make_channel)
+        except Exception:
+            for ch in self._channels:
+                ch.destroy()
+            raise
+
+    def _build(self, order, output_node, make_channel) -> None:
+        input_consumers = sum(
+            1 for n in order for a in n.args if isinstance(a, InputNode))
+        if input_consumers > 1:
+            raise ValueError(
+                "only one compiled node may consume InputNode (SPSC "
+                "channels); fan the input out with an explicit stage")
+        self._input_ch = make_channel("in")
+        out_ch_of: Dict[int, Channel] = {}
+        for i, n in enumerate(order):
+            out_ch_of[id(n)] = make_channel(f"n{i}")
+        self._output_ch = out_ch_of[id(output_node)]
+
+        # Start each node's resident loop.
+        for i, n in enumerate(order):
+            in_chs: List[Channel] = []
+            arg_slots: List[int] = []
+            const_args: List[Any] = list(n.args)
+            for slot, a in enumerate(n.args):
+                if isinstance(a, InputNode):
+                    in_chs.append(self._input_ch)
+                    arg_slots.append(slot)
+                    const_args[slot] = None
+                elif isinstance(a, ClassMethodNode):
+                    in_chs.append(out_ch_of[id(a)])
+                    arg_slots.append(slot)
+                    const_args[slot] = None
+            if not in_chs:
+                raise ValueError(
+                    f"node {n.method_name!r} consumes no upstream — "
+                    f"bind it to InputNode or another node")
+            ref = n.actor.dag_exec_loop.remote(
+                n.method_name, in_chs, const_args, arg_slots,
+                out_ch_of[id(n)])
+            self._loops.append(ref)
+
+    # ---------------------------------------------------------------- call
+    def execute(self, value: Any) -> "DAGFuture":
+        if self._torn_down:
+            raise RuntimeError("compiled DAG was torn down")
+        self._input_ch.write(value, timeout=self._timeout)
+        seq = self._next_seq
+        self._next_seq += 1
+        return DAGFuture(self, seq)
+
+    def _result_for(self, seq: int) -> Any:
+        # The linear SPSC chain preserves order: output k belongs to
+        # invocation k.  Cache results read on behalf of later gets so
+        # out-of-order future resolution stays correct.
+        while seq not in self._results:
+            out = self._output_ch.read(timeout=self._timeout)
+            self._results[self._read_seq] = out
+            self._read_seq += 1
+        out = self._results.pop(seq)
+        if isinstance(out, _Err):
+            raise out.error
+        if isinstance(out, _Stop):
+            raise RuntimeError("compiled DAG stopped")
+        return out
+
+    def teardown(self) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        try:
+            self._input_ch.write(_Stop(), timeout=5.0)
+            # Drain unread outputs so a back-pressured terminal stage
+            # can make progress and observe the sentinel.
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                try:
+                    out = self._output_ch.read(timeout=1.0)
+                except Exception:
+                    break
+                if isinstance(out, _Stop):
+                    break
+            ray_tpu.wait(self._loops, num_returns=len(self._loops),
+                         timeout=10.0)
+        except Exception:
+            pass
+        for ch in self._channels:
+            ch.destroy()
+
+
+class DAGFuture:
+    """One in-flight DAG invocation (execute() pipelines: several may
+    be in flight up to channel capacity).  Sequence-tagged, so futures
+    may be resolved in any order."""
+
+    def __init__(self, dag: CompiledDAG, seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._done = False
+        self._value: Any = None
+
+    def get(self) -> Any:
+        if not self._done:
+            self._value = self._dag._result_for(self._seq)
+            self._done = True
+        return self._value

@@ -1,0 +1,6 @@
+"""ray_tpu.experimental — channels for compiled DAGs.
+
+Role-equivalent to the reference's python/ray/experimental/channel/.
+"""
+
+from .channel import Channel, ShmChannel  # noqa

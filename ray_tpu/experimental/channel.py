@@ -1,0 +1,176 @@
+"""Typed channels: pre-negotiated data paths between DAG stages.
+
+Role-equivalent to the reference's channel layer (ref:
+python/ray/experimental/channel/shared_memory_channel.py over mutable
+plasma objects, C++ experimental_mutable_object_manager.cc).  TPU
+framing: host-side stage hand-off is a single-producer single-consumer
+ring over ONE shared-memory segment — a write is a memcpy + index bump,
+a read is the reverse; no RPC, no scheduler, no pickle-frame per hop.
+Device tensors never ride these channels: between chips they move
+in-graph over ICI (collectives inside the jitted step), so the channel
+plane only carries host metadata and host arrays.
+
+Layout: [u64 write_seq | u64 read_seq | slots x (u64 len | payload)].
+SPSC discipline: exactly one producer and one consumer process; seq
+counters are monotonic, slot = seq % capacity, and the paired index
+updates give the needed happens-before on x86/ARM via the GIL's
+memory fences around memoryview assignment.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from multiprocessing import shared_memory
+from typing import Any, Optional
+
+_HDR = 16  # two u64 sequence counters
+
+
+class ChannelFull(Exception):
+    pass
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Channel:
+    """Spec + lazy attach; picklable into actors (ref: ChannelInterface)."""
+
+    def __init__(self, name: str, slot_bytes: int = 1 << 20,
+                 num_slots: int = 8, create: bool = False):
+        self.name = name
+        self.slot_bytes = slot_bytes
+        self.num_slots = num_slots
+        self._impl: Optional[ShmChannel] = None
+        if create:
+            ShmChannel(name, slot_bytes, num_slots, create=True).close()
+
+    def _get(self) -> "ShmChannel":
+        if self._impl is None:
+            self._impl = ShmChannel(self.name, self.slot_bytes,
+                                    self.num_slots)
+        return self._impl
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        self._get().write(value, timeout)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        return self._get().read(timeout)
+
+    def close(self) -> None:
+        if self._impl is not None:
+            self._impl.close()
+            self._impl = None
+
+    def destroy(self) -> None:
+        self.close()
+        ShmChannel.unlink(self.name)
+
+    def __reduce__(self):
+        return (Channel, (self.name, self.slot_bytes, self.num_slots))
+
+
+class ShmChannel:
+    """The mapped SPSC ring itself."""
+
+    def __init__(self, name: str, slot_bytes: int, num_slots: int,
+                 create: bool = False):
+        self.slot_bytes = slot_bytes
+        self.num_slots = num_slots
+        slot_stride = 8 + slot_bytes
+        total = _HDR + num_slots * slot_stride
+        if create:
+            try:
+                self._seg = shared_memory.SharedMemory(
+                    name=name, create=True, size=total)
+            except FileExistsError:
+                # Stale segment from a crashed run: its counters and
+                # geometry are untrustworthy — replace it.
+                old = shared_memory.SharedMemory(name=name)
+                try:
+                    from multiprocessing import resource_tracker
+
+                    resource_tracker.unregister(old._name,
+                                                "shared_memory")
+                except Exception:
+                    pass
+                old.close()
+                old.unlink()
+                self._seg = shared_memory.SharedMemory(
+                    name=name, create=True, size=total)
+            self._seg.buf[:_HDR] = b"\x00" * _HDR
+        else:
+            self._seg = shared_memory.SharedMemory(name=name)
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(self._seg._name, "shared_memory")
+        except Exception:
+            pass
+        self._stride = slot_stride
+
+    # ------------------------------------------------------------- counters
+    def _seq(self, idx: int) -> int:
+        return int.from_bytes(self._seg.buf[idx * 8:(idx + 1) * 8],
+                              "little")
+
+    def _set_seq(self, idx: int, v: int) -> None:
+        self._seg.buf[idx * 8:(idx + 1) * 8] = v.to_bytes(8, "little")
+
+    # ---------------------------------------------------------------- ops
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        data = pickle.dumps(value, protocol=5)
+        if len(data) > self.slot_bytes:
+            raise ValueError(
+                f"message of {len(data)} bytes exceeds slot size "
+                f"{self.slot_bytes}; size the channel for its payloads")
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        while True:
+            w, r = self._seq(0), self._seq(1)
+            if w - r < self.num_slots:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelFull(self._seg.name)
+            time.sleep(0.0002)
+        off = _HDR + (w % self.num_slots) * self._stride
+        self._seg.buf[off:off + 8] = len(data).to_bytes(8, "little")
+        self._seg.buf[off + 8:off + 8 + len(data)] = data
+        self._set_seq(0, w + 1)  # publish
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        while True:
+            w, r = self._seq(0), self._seq(1)
+            if r < w:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self._seg.name} empty")
+            time.sleep(0.0002)
+        off = _HDR + (r % self.num_slots) * self._stride
+        n = int.from_bytes(self._seg.buf[off:off + 8], "little")
+        value = pickle.loads(self._seg.buf[off + 8:off + 8 + n])
+        self._set_seq(1, r + 1)  # consume
+        return value
+
+    def close(self) -> None:
+        try:
+            self._seg.close()
+        except BufferError:
+            pass
+
+    @staticmethod
+    def unlink(name: str) -> None:
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(seg._name, "shared_memory")
+            except Exception:
+                pass
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass
