@@ -261,14 +261,16 @@ class ActorClass:
 
     def __init__(self, cls, options: Dict[str, Any]):
         # Inject the compiled-DAG resident loop as an actor method (ref:
-        # compiled DAGs' do_exec_tasks entrypoint on every actor).
-        if not hasattr(cls, "dag_exec_loop"):
-            from ..dag import _dag_exec_loop
+        # compiled DAGs' do_exec_tasks entrypoint on every actor).  The
+        # rt_-prefixed name is reserved; always set it so a user
+        # attribute of the same name cannot silently receive
+        # loop-protocol arguments.
+        from ..dag import _dag_exec_loop
 
-            try:
-                cls.dag_exec_loop = _dag_exec_loop
-            except (AttributeError, TypeError):
-                pass  # frozen/extension classes opt out of DAG support
+        try:
+            cls.rt_dag_exec_loop = _dag_exec_loop
+        except (AttributeError, TypeError):
+            pass  # frozen/extension classes opt out of DAG support
         self._cls = cls
         self._options = options
         self._blob: Optional[bytes] = None

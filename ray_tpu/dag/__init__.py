@@ -107,8 +107,20 @@ def _dag_exec_loop(self, method_name: str, in_channels: List[Channel],
                 return False
         return False
 
+    def pull(ch):
+        # Bounded read: if the DAG was torn down behind our back (the
+        # stop sentinel never reached us), notice the unlinked segment
+        # and exit instead of polling an orphan ring forever.
+        while True:
+            try:
+                return ch.read(timeout=10.0)
+            except TimeoutError:
+                if not ch.exists():
+                    return _Stop()
+                continue
+
     while True:
-        vals = [ch.read() for ch in in_channels]
+        vals = [pull(ch) for ch in in_channels]
         if any(isinstance(v, _Stop) for v in vals):
             push(_Stop())
             return "stopped"
@@ -182,12 +194,19 @@ class CompiledDAG:
             order.append(n)
 
         visit(output_node)
+        loops_per_actor: Dict[Any, int] = {}
         for n in order:
-            if getattr(n.actor, "_max_concurrency", 1) < 2:
+            key = getattr(n.actor, "_actor_id", id(n.actor))
+            loops_per_actor[key] = loops_per_actor.get(key, 0) + 1
+        for n in order:
+            key = getattr(n.actor, "_actor_id", id(n.actor))
+            need = loops_per_actor[key] + 1
+            if getattr(n.actor, "_max_concurrency", 1) < need:
                 raise ValueError(
                     f"actor hosting {n.method_name!r} needs "
-                    f"max_concurrency >= 2: the resident DAG loop "
-                    f"occupies one slot for the DAG's lifetime")
+                    f"max_concurrency >= {need}: each resident DAG "
+                    f"loop occupies one slot for the DAG's lifetime "
+                    f"(this actor hosts {loops_per_actor[key]})")
 
         def make_channel(tag: str) -> Channel:
             ch = Channel(f"rtdag_{self._id}_{tag}",
@@ -234,7 +253,7 @@ class CompiledDAG:
                 raise ValueError(
                     f"node {n.method_name!r} consumes no upstream — "
                     f"bind it to InputNode or another node")
-            ref = n.actor.dag_exec_loop.remote(
+            ref = n.actor.rt_dag_exec_loop.remote(
                 n.method_name, in_chs, const_args, arg_slots,
                 out_ch_of[id(n)])
             self._loops.append(ref)
@@ -300,6 +319,13 @@ class DAGFuture:
 
     def get(self) -> Any:
         if not self._done:
-            self._value = self._dag._result_for(self._seq)
+            try:
+                self._value = self._dag._result_for(self._seq)
+            except Exception as e:  # noqa: BLE001 — replayed on re-get
+                self._error = e
+                self._done = True
+                raise
             self._done = True
+        if getattr(self, "_error", None) is not None:
+            raise self._error
         return self._value

@@ -1,0 +1,131 @@
+"""Minimal cluster dashboard: HTTP views over the state API + metrics.
+
+Role-equivalent to the reference's dashboard head (ref:
+python/ray/dashboard/ — head.py + http_server_head.py + module REST
+endpoints), reduced to the TPU-operations core: one aiohttp server that
+any machine can point at the controller, serving JSON state endpoints,
+the Prometheus exposition, and a self-refreshing HTML overview.  The
+heavyweight per-node agent/reporter tree is deliberately absent — node
+stats already flow through agent heartbeats into controller metrics.
+
+Run: ``rt dashboard [--address ...] [--port 8265]`` or
+``python -m ray_tpu.dashboard``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: monospace; margin: 2em; background: #fafafa; }
+ h1 { font-size: 1.3em; } h2 { font-size: 1.05em; margin-top: 1.4em; }
+ table { border-collapse: collapse; margin-top: .4em; }
+ td, th { border: 1px solid #ccc; padding: 3px 9px; font-size: .85em;
+          text-align: left; }
+ th { background: #eee; }
+ .ALIVE, .FINISHED, .SUCCEEDED, .RUNNING { color: #0a7a0a; }
+ .DEAD, .FAILED, .ERRORED { color: #c02020; }
+</style></head>
+<body>
+<h1>ray_tpu cluster</h1>
+<div id="root">loading…</div>
+<script>
+async function grab(p) { return (await fetch(p)).json(); }
+function table(rows, cols) {
+  if (!rows.length) return "<i>(none)</i>";
+  let h = "<table><tr>" + cols.map(c => `<th>${c}</th>`).join("") +
+          "</tr>";
+  for (const r of rows)
+    h += "<tr>" + cols.map(c => {
+      const v = r[c] === undefined ? "" : r[c];
+      return `<td class="${v}">${typeof v === "object" ?
+              JSON.stringify(v) : v}</td>`; }).join("") + "</tr>";
+  return h + "</table>";
+}
+async function refresh() {
+  const [nodes, actors, tasks, jobs] = await Promise.all([
+    grab("/api/nodes"), grab("/api/actors"),
+    grab("/api/tasks?limit=50"), grab("/api/jobs")]);
+  document.getElementById("root").innerHTML =
+    "<h2>Nodes</h2>" + table(nodes, ["node_id", "agent_addr", "alive",
+                                     "is_head", "resources",
+                                     "available"]) +
+    "<h2>Actors</h2>" + table(actors, ["actor_id", "class_name",
+                                       "state", "name", "node_id"]) +
+    "<h2>Recent tasks</h2>" + table(tasks, ["name", "state", "kind",
+                                            "node_id", "worker_pid",
+                                            "error"]) +
+    "<h2>Jobs</h2>" + table(jobs, ["job_id", "driver", "alive"]) +
+    `<p><a href="/metrics">/metrics</a> (Prometheus)</p>`;
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+
+def create_app(address: Optional[str] = None):
+    import asyncio
+
+    from aiohttp import web
+
+    from ..util import state as state_api
+
+    async def call(fn, **kw):
+        # State calls are synchronous (they spin their own event loop /
+        # runtime io thread) — keep them off aiohttp's loop.
+        return await asyncio.get_event_loop().run_in_executor(
+            None, lambda: fn(address=address, **kw))
+
+    async def index(_req):
+        return web.Response(text=_PAGE, content_type="text/html")
+
+    async def nodes(_req):
+        return web.json_response(
+            json.loads(json.dumps(await call(state_api.list_nodes),
+                                  default=repr)))
+
+    async def actors(_req):
+        return web.json_response(
+            json.loads(json.dumps(await call(state_api.list_actors),
+                                  default=repr)))
+
+    async def tasks(req):
+        limit = int(req.query.get("limit", 100))
+        return web.json_response(
+            json.loads(json.dumps(
+                await call(state_api.list_tasks, limit=limit), default=repr)))
+
+    async def jobs(_req):
+        return web.json_response(
+            json.loads(json.dumps(await call(state_api.list_jobs),
+                                  default=repr)))
+
+    async def objects(_req):
+        return web.json_response(
+            json.loads(json.dumps(await call(state_api.list_objects),
+                                  default=repr)))
+
+    async def metrics(_req):
+        return web.Response(text=await call(state_api.metrics_text),
+                            content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/", index)
+    app.router.add_get("/api/nodes", nodes)
+    app.router.add_get("/api/actors", actors)
+    app.router.add_get("/api/tasks", tasks)
+    app.router.add_get("/api/jobs", jobs)
+    app.router.add_get("/api/objects", objects)
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+def run_dashboard(address: Optional[str] = None, port: int = 8265,
+                  host: str = "0.0.0.0") -> None:
+    from aiohttp import web
+
+    web.run_app(create_app(address), host=host, port=port,
+                print=lambda *a: None)

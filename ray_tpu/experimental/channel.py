@@ -12,9 +12,11 @@ plane only carries host metadata and host arrays.
 
 Layout: [u64 write_seq | u64 read_seq | slots x (u64 len | payload)].
 SPSC discipline: exactly one producer and one consumer process; seq
-counters are monotonic, slot = seq % capacity, and the paired index
-updates give the needed happens-before on x86/ARM via the GIL's
-memory fences around memoryview assignment.
+counters are monotonic and slot = seq % capacity.  Memory model: the
+payload-before-counter ordering relies on TSO (x86) — TPU VM hosts are
+x86 — plus double-read counter validation against torn 8-byte updates;
+a weakly-ordered host (aarch64) would need the native-atomics path in
+src/ (same pattern as shm_pool.cpp) before trusting these rings.
 """
 
 from __future__ import annotations
@@ -63,6 +65,22 @@ class Channel:
         if self._impl is not None:
             self._impl.close()
             self._impl = None
+
+    def exists(self) -> bool:
+        """Is the backing segment still linked?  (Loops poll this to
+        notice a teardown they missed.)"""
+        try:
+            seg = shared_memory.SharedMemory(name=self.name)
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(seg._name, "shared_memory")
+            except Exception:
+                pass
+            seg.close()
+            return True
+        except FileNotFoundError:
+            return False
 
     def destroy(self) -> None:
         self.close()
@@ -113,8 +131,15 @@ class ShmChannel:
 
     # ------------------------------------------------------------- counters
     def _seq(self, idx: int) -> int:
-        return int.from_bytes(self._seg.buf[idx * 8:(idx + 1) * 8],
-                              "little")
+        # Double-read until stable: the 8-byte counter store is a
+        # byte-wise memcpy, so guard against torn reads across a carry.
+        while True:
+            a = int.from_bytes(self._seg.buf[idx * 8:(idx + 1) * 8],
+                               "little")
+            b = int.from_bytes(self._seg.buf[idx * 8:(idx + 1) * 8],
+                               "little")
+            if a == b:
+                return a
 
     def _set_seq(self, idx: int, v: int) -> None:
         self._seg.buf[idx * 8:(idx + 1) * 8] = v.to_bytes(8, "little")
@@ -127,13 +152,15 @@ class ShmChannel:
                 f"message of {len(data)} bytes exceeds slot size "
                 f"{self.slot_bytes}; size the channel for its payloads")
         deadline = time.monotonic() + timeout if timeout is not None else None
+        delay = 0.0002
         while True:
             w, r = self._seq(0), self._seq(1)
             if w - r < self.num_slots:
                 break
             if deadline is not None and time.monotonic() > deadline:
                 raise ChannelFull(self._seg.name)
-            time.sleep(0.0002)
+            time.sleep(delay)
+            delay = min(delay * 1.5, 0.005)  # idle backoff
         off = _HDR + (w % self.num_slots) * self._stride
         self._seg.buf[off:off + 8] = len(data).to_bytes(8, "little")
         self._seg.buf[off + 8:off + 8 + len(data)] = data
@@ -141,13 +168,15 @@ class ShmChannel:
 
     def read(self, timeout: Optional[float] = None) -> Any:
         deadline = time.monotonic() + timeout if timeout is not None else None
+        delay = 0.0002
         while True:
             w, r = self._seq(0), self._seq(1)
             if r < w:
                 break
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"channel {self._seg.name} empty")
-            time.sleep(0.0002)
+            time.sleep(delay)
+            delay = min(delay * 1.5, 0.005)  # idle backoff
         off = _HDR + (r % self.num_slots) * self._stride
         n = int.from_bytes(self._seg.buf[off:off + 8], "little")
         value = pickle.loads(self._seg.buf[off + 8:off + 8 + n])

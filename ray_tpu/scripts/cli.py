@@ -365,6 +365,18 @@ def _cmd_job_inner(args) -> int:
     return 2
 
 
+def cmd_dashboard(args) -> int:
+    from ray_tpu.dashboard import run_dashboard
+
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    print(f"dashboard for {address} on http://0.0.0.0:{args.port}")
+    run_dashboard(address, args.port)
+    return 0
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="rt", description="ray_tpu cluster CLI")
@@ -422,6 +434,11 @@ def _build_parser() -> argparse.ArgumentParser:
                         help="print Prometheus metrics exposition")
     sp.add_argument("--address", default="")
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("dashboard", help="serve the web dashboard")
+    sp.add_argument("--address", default="")
+    sp.add_argument("--port", type=int, default=8265)
+    sp.set_defaults(fn=cmd_dashboard)
 
     sp = sub.add_parser("job", help="submit and manage cluster jobs")
     jsub = sp.add_subparsers(dest="job_command", required=True)

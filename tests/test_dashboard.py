@@ -1,0 +1,80 @@
+"""HTTP dashboard over the state API (ref: python/ray/dashboard/ —
+reduced to REST endpoints + overview page)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+def test_dashboard_endpoints():
+    import threading
+
+    from aiohttp import web
+
+    from ray_tpu.dashboard import create_app
+
+    rt = ray_tpu.init(mode="cluster", num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def work():
+            return 1
+
+        @ray_tpu.remote
+        class Keeper:
+            def ping(self):
+                return True
+
+        k = Keeper.options(name="dash_keeper").remote()
+        assert ray_tpu.get(k.ping.remote(), timeout=60)
+        assert ray_tpu.get(work.remote(), timeout=60) == 1
+
+        app = create_app(rt.controller_addr)
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        runner = web.AppRunner(app)
+        port_holder = {}
+
+        def serve():
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            loop.run_until_complete(site.start())
+            port_holder["port"] = site._server.sockets[0].getsockname()[1]
+            loop.run_forever()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        import time
+
+        deadline = time.time() + 30
+        while "port" not in port_holder and time.time() < deadline:
+            time.sleep(0.05)
+        port = port_holder["port"]
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+                return r.read().decode()
+
+        html = fetch("/")
+        assert "ray_tpu cluster" in html
+        nodes = json.loads(fetch("/api/nodes"))
+        assert len(nodes) == 1 and nodes[0]["alive"]
+        actors = json.loads(fetch("/api/actors"))
+        assert any(a.get("name") == "dash_keeper" for a in actors)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            tasks = json.loads(fetch("/api/tasks"))
+            if any(t.get("name") == "work" for t in tasks):
+                break
+            time.sleep(0.5)
+        else:
+            raise TimeoutError("task never appeared in dashboard")
+        assert "rt_nodes_alive" in fetch("/metrics")
+        loop.call_soon_threadsafe(loop.stop)
+    finally:
+        ray_tpu.shutdown()
