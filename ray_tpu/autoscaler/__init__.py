@@ -7,6 +7,10 @@ provider, gcp/tpu pod node types).
 """
 
 from .autoscaler import NodeType, StandardAutoscaler  # noqa
+from .cluster_spec import ClusterSpec, load_cluster_spec  # noqa
+from .command_runner import (CommandRunner, PodCommandRunner,  # noqa
+                             SSHCommandRunner, SubprocessCommandRunner)
 from .fake_provider import FakeNodeProvider  # noqa
 from .node_provider import NodeProvider  # noqa
+from .remote_provider import RemoteNodeProvider  # noqa
 from .sdk import AutoscalingCluster  # noqa

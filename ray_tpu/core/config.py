@@ -101,9 +101,11 @@ define_flag("memory_usage_threshold", float, 0.95,
             "kills workers running retriable work.")
 define_flag("memory_monitor_refresh_ms", int, 1000,
             "OOM monitor sampling period; 0 disables the monitor.")
-define_flag("controller_persistence_enabled", bool, False,
+define_flag("controller_persistence_enabled", bool, True,
             "Snapshot controller tables to the session dir so a "
-            "restarted controller resumes (GCS fault tolerance).")
+            "restarted controller resumes (GCS fault tolerance). "
+            "Default-on: matches the reference running GCS over a "
+            "persistent store (ref: gcs_server.h:113 StorageType).")
 define_flag("controller_reconnect_grace_s", float, 30.0,
             "How long agents tolerate an unreachable controller "
             "(reconnect window across a controller restart) before "
@@ -111,10 +113,12 @@ define_flag("controller_reconnect_grace_s", float, 30.0,
 define_flag("object_transfer_chunk_bytes", int, 4 * 1024**2,
             "Node-to-node object transfer chunk size; larger objects "
             "move as a sequence of chunk RPCs, not one giant frame.")
-define_flag("object_store_backend", str, "segments",
-            "Node object store backing: 'segments' (one shm segment "
-            "per object) or 'pool' (native C++ slab allocator over one "
-            "shm region, src/shm_pool.cpp).")
+define_flag("object_store_backend", str, "pool",
+            "Node object store backing: 'pool' (native C++ slab "
+            "allocator over one shm region, src/shm_pool.cpp — the "
+            "production path, like the reference's plasma slab; falls "
+            "back to segments if the toolchain is missing) or "
+            "'segments' (one shm segment per object).")
 define_flag("object_spill_enabled", bool, True,
             "Spill pinned objects to disk under store pressure instead "
             "of running over capacity.")

@@ -11,7 +11,8 @@ Deviation from the reference, on purpose: the object *location* directory
 is centralized here rather than owner-distributed — at TPU-host
 granularity the directory is small (hosts, not chips, hold objects) and a
 single authority removes the owner-failure protocol; lineage-based
-reconstruction still lives with the owning worker (task_manager.py).
+reconstruction still lives with the owning worker (see
+cluster_runtime.py:_reconstruct_object and its retry bookkeeping).
 """
 
 from __future__ import annotations

@@ -153,6 +153,12 @@ def cmd_start(args) -> int:
     else:
         print(f"Joined cluster at {ctl_addr}.\n"
               f"  node agent: {agent_addr} ({node_id[:12]})")
+    # Machine-readable trailer: the cluster launcher (`rt up`, the SSH
+    # node provider) parses these from the remote command's output.
+    print(f"RT_ADDRESS={ctl_addr}")
+    print(f"RT_SESSION={session}")
+    print(f"RT_NODE_ID={node_id}")
+    print(f"RT_PIDS={','.join(str(p) for p in pids)}")
     if args.block:
         try:
             while agent_proc.poll() is None:
@@ -365,6 +371,45 @@ def _cmd_job_inner(args) -> int:
     return 2
 
 
+def cmd_up(args) -> int:
+    from ray_tpu.autoscaler import commands as _commands
+
+    state = _commands.up(args.spec, no_autoscaler=args.no_autoscaler,
+                         no_workers=args.no_workers)
+    print(f"Cluster {state['cluster_name']} is up.\n"
+          f"  address: {state['address']}\n"
+          f"  session: {state['session']}\n"
+          f"  workers launched: {len(state.get('launched', {}))}"
+          + ("\n  autoscaler: running on head"
+             if state.get("autoscaler") else ""))
+    print(f"RT_ADDRESS={state['address']}")
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_tpu.autoscaler import commands as _commands
+
+    _commands.down(args.spec)
+    print("Cluster torn down.")
+    return 0
+
+
+def cmd_exec(args) -> int:
+    from ray_tpu.autoscaler import commands as _commands
+
+    for out in _commands.exec_cluster(args.spec, args.cmd,
+                                      all_nodes=args.all_nodes):
+        print(out, end="" if out.endswith("\n") else "\n")
+    return 0
+
+
+def cmd_autoscale(args) -> int:
+    from ray_tpu.autoscaler import commands as _commands
+
+    _commands.run_autoscaler(args.spec, args.address)
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     from ray_tpu.dashboard import run_dashboard
 
@@ -439,6 +484,35 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address", default="")
     sp.add_argument("--port", type=int, default=8265)
     sp.set_defaults(fn=cmd_dashboard)
+
+    sp = sub.add_parser("up", help="launch a cluster from a YAML spec")
+    sp.add_argument("spec", help="cluster YAML (see autoscaler/"
+                                 "cluster_spec.py for the schema)")
+    sp.add_argument("--no-autoscaler", action="store_true",
+                    help="don't start the scaling loop on the head")
+    sp.add_argument("--no-workers", action="store_true",
+                    help="head only; skip min_workers bring-up")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down an `rt up` cluster")
+    sp.add_argument("spec")
+    sp.set_defaults(fn=cmd_down)
+
+    sp = sub.add_parser("exec",
+                        help="run a shell command on cluster hosts")
+    sp.add_argument("spec")
+    sp.add_argument("cmd", help="shell command to run")
+    sp.add_argument("--all-nodes", action="store_true",
+                    help="run on every known host, not just the head")
+    sp.set_defaults(fn=cmd_exec)
+
+    sp = sub.add_parser("autoscale",
+                        help="run the scaling loop for a YAML cluster "
+                             "(normally started on the head by rt up)")
+    sp.add_argument("spec")
+    sp.add_argument("--address", required=True,
+                    help="controller address")
+    sp.set_defaults(fn=cmd_autoscale)
 
     sp = sub.add_parser("job", help="submit and manage cluster jobs")
     jsub = sp.add_subparsers(dest="job_command", required=True)

@@ -27,9 +27,14 @@ class _Replica:
     def __init__(self, cls_payload: bytes, init_args: tuple,
                  init_kwargs: dict, is_function: bool):
         import cloudpickle
+        import threading
 
         target = cloudpickle.loads(cls_payload)
         self._is_function = is_function
+        # Autoscaling decisions ride on this counter and the replica runs
+        # with max_concurrency=32, so guard it with a real lock instead
+        # of relying on CPython's GIL making `+= 1` atomic-enough.
+        self._ongoing_lock = threading.Lock()
         self._ongoing = 0
         if is_function:
             self._fn = target
@@ -38,11 +43,19 @@ class _Replica:
             self._instance = target(*init_args, **init_kwargs)
             self._fn = None
 
+    def _enter(self) -> None:
+        with self._ongoing_lock:
+            self._ongoing += 1
+
+    def _exit(self) -> None:
+        with self._ongoing_lock:
+            self._ongoing -= 1
+
     def handle_request(self, args: tuple, kwargs: dict):
         import asyncio
         import inspect
 
-        self._ongoing += 1
+        self._enter()
         try:
             target = self._fn if self._is_function else self._instance
             result = target(*args, **kwargs)
@@ -53,14 +66,14 @@ class _Replica:
                         result, asyncio.get_event_loop()).result()
             return result
         finally:
-            self._ongoing -= 1
+            self._exit()
 
     def call_method(self, method: str, args: tuple, kwargs: dict):
-        self._ongoing += 1
+        self._enter()
         try:
             return getattr(self._instance, method)(*args, **kwargs)
         finally:
-            self._ongoing -= 1
+            self._exit()
 
     def ongoing(self) -> int:
         return self._ongoing

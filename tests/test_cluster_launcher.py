@@ -1,0 +1,295 @@
+"""`rt up` cluster launcher + SSH-shaped node provider, hermetically.
+
+The provider/runner contract is exercised end-to-end with
+``provider.type: subprocess`` — the identical code path as SSH (shell
+command strings, RT_* trailer parsing, pid-kill termination) with the
+"remote machine" being this host (ref pattern:
+autoscaler/_private/fake_multi_node/ applied to commands.py +
+tpu_command_runner.py).
+"""
+
+import os
+import subprocess
+import time
+
+import pytest
+import yaml
+
+import ray_tpu
+from ray_tpu.autoscaler.cluster_spec import (parse_cluster_spec,
+                                             load_cluster_spec)
+from ray_tpu.autoscaler.command_runner import (CommandRunnerError,
+                                               PodCommandRunner,
+                                               SSHCommandRunner,
+                                               SubprocessCommandRunner)
+from ray_tpu.autoscaler.remote_provider import (RemoteNodeProvider,
+                                                split_slice_resources)
+from ray_tpu.autoscaler import commands as rt_commands
+
+
+# ------------------------------------------------------------- unit level
+def test_subprocess_runner_run_and_env(tmp_path):
+    r = SubprocessCommandRunner()
+    assert r.run("echo hello").strip() == "hello"
+    out = r.run("echo $RT_TEST_VAR", env={"RT_TEST_VAR": "42"})
+    assert out.strip() == "42"
+    with pytest.raises(CommandRunnerError):
+        r.run("exit 3")
+    # put copies files and trees
+    src = tmp_path / "a.txt"
+    src.write_text("data")
+    dst = tmp_path / "sub" / "b.txt"
+    r.put(str(src), str(dst))
+    assert dst.read_text() == "data"
+
+
+def test_pod_runner_fans_out_with_per_host_env(tmp_path):
+    hosts = [SubprocessCommandRunner(f"h{i}") for i in range(3)]
+    pod = PodCommandRunner(hosts)
+    outs = pod.run_per_host(
+        "echo $RT_TPU_WORKER_ID",
+        per_host_env=[{"RT_TPU_WORKER_ID": str(i)} for i in range(3)])
+    assert [o.strip() for o in outs] == ["0", "1", "2"]
+    # one host failing surfaces as an aggregate error
+    with pytest.raises(CommandRunnerError):
+        pod.run_per_host("test $RT_TPU_WORKER_ID != 1",
+                         per_host_env=[{"RT_TPU_WORKER_ID": str(i)}
+                                       for i in range(3)])
+
+
+def test_ssh_runner_command_shape():
+    r = SSHCommandRunner("10.0.0.5", user="ubuntu",
+                         key_file="/tmp/k.pem", port=2222)
+    base = r._ssh_base()
+    assert base[0] == "ssh"
+    assert "-p" in base and "2222" in base
+    assert "-i" in base and "/tmp/k.pem" in base
+    assert r._target() == "ubuntu@10.0.0.5"
+
+
+def test_split_slice_resources():
+    shares = split_slice_resources(
+        {"TPU": 8.0, "CPU": 16.0, "slice-v5e-8": 1.0}, 2)
+    assert shares[0] == {"TPU": 4.0, "CPU": 8.0, "slice-v5e-8": 1.0}
+    assert shares[1] == {"TPU": 4.0, "CPU": 8.0}
+
+
+def test_spec_validation_errors():
+    with pytest.raises(ValueError, match="missing required key"):
+        parse_cluster_spec({"cluster_name": "x"})
+    base = {
+        "cluster_name": "x",
+        "provider": {"type": "ssh", "head_host": "h0"},
+        "head_node_type": "head",
+        "available_node_types": {
+            "head": {"resources": {"CPU": 1}},
+            "w": {"resources": {"CPU": 1}, "max_workers": 2},
+        },
+    }
+    with pytest.raises(ValueError, match="no hosts"):
+        parse_cluster_spec(base)
+    ok = dict(base)
+    ok["provider"] = {**base["provider"],
+                      "worker_hosts": {"w": ["h1", "h2"]}}
+    spec = parse_cluster_spec(ok)
+    assert spec.hosts_for("w") == ["h1", "h2"]
+    # slice length must match hosts_per_slice
+    bad = dict(ok)
+    bad["available_node_types"] = {
+        **ok["available_node_types"],
+        "tpu": {"resources": {"TPU": 8}, "max_workers": 1,
+                "hosts_per_slice": 2},
+    }
+    bad["provider"] = {**ok["provider"],
+                       "tpu_slices": {"tpu": [["a", "b", "c"]]}}
+    with pytest.raises(ValueError, match="expected 2"):
+        parse_cluster_spec(bad)
+
+
+# --------------------------------------------------------- end-to-end up
+@pytest.fixture
+def launcher_spec(tmp_path):
+    """A hermetic cluster: head + 1 min cpu worker + a 2-host TPU slice
+    type the autoscaler can launch on demand."""
+    spec = {
+        "cluster_name": f"launchtest_{os.getpid()}",
+        "provider": {"type": "subprocess", "head_port": 0},
+        "head_node_type": "head",
+        "available_node_types": {
+            "head": {"resources": {"CPU": 2}},
+            "cpu_worker": {"resources": {"CPU": 2},
+                           "min_workers": 1, "max_workers": 2},
+            "tpu_slice": {"resources": {"TPU": 8, "slice-v5e-8": 1},
+                          "min_workers": 0, "max_workers": 1,
+                          "hosts_per_slice": 2},
+        },
+        "idle_timeout_s": 600,
+    }
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(spec))
+    yield str(path)
+    try:
+        rt_commands.down(str(path))
+    except Exception:
+        pass
+
+
+def _alive_nodes(address):
+    import asyncio
+
+    from ray_tpu.core.rpc import RpcClient
+
+    async def _go():
+        cli = RpcClient(address, tag="test")
+        try:
+            return await asyncio.wait_for(cli.call("list_nodes", {}),
+                                          10.0)
+        finally:
+            await cli.close()
+
+    nodes = asyncio.new_event_loop().run_until_complete(_go())
+    return [n for n in nodes if n["alive"]]
+
+
+def test_rt_up_exec_scale_down(launcher_spec):
+    state = rt_commands.up(launcher_spec, no_autoscaler=True)
+    address = state["address"]
+    assert state["head_pids"]
+    assert len(state["launched"]) == 1  # min_workers cpu_worker
+
+    # Head agent + 1 worker agent registered and alive.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if len(_alive_nodes(address)) >= 2:
+            break
+        time.sleep(0.5)
+    nodes = _alive_nodes(address)
+    assert len(nodes) == 2
+
+    # rt up is idempotent while the cluster answers pings.
+    state2 = rt_commands.up(launcher_spec, no_autoscaler=True)
+    assert state2["address"] == address
+
+    # rt exec reaches the head host.
+    outs = rt_commands.exec_cluster(launcher_spec, "echo from-head")
+    assert "from-head" in outs[0]
+
+    # The provider launches a whole TPU slice atomically: both hosts
+    # join as agents, chips split across them, slice label on host 0.
+    spec = load_cluster_spec(launcher_spec)
+    provider = RemoteNodeProvider(spec, address)
+    pid = provider.create_node("tpu_slice",
+                               {"TPU": 8, "slice-v5e-8": 1})
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if len(_alive_nodes(address)) >= 4:
+            break
+        time.sleep(0.5)
+    nodes = _alive_nodes(address)
+    assert len(nodes) == 4
+    tpu_nodes = [n for n in nodes if n["resources"].get("TPU")]
+    assert len(tpu_nodes) == 2
+    assert all(n["resources"]["TPU"] == 4.0 for n in tpu_nodes)
+    assert sum(1 for n in tpu_nodes
+               if n["resources"].get("slice-v5e-8")) == 1
+    assert provider.node_cluster_id(pid)
+
+    # Terminating the slice takes BOTH hosts down.
+    provider.terminate_node(pid)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if len(_alive_nodes(address)) == 2:
+            break
+        time.sleep(0.5)
+    assert len(_alive_nodes(address)) == 2
+
+    # rt down kills everything it recorded.
+    head_pid = state["head_pids"][0]
+    rt_commands.down(launcher_spec)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            os.kill(head_pid, 0)
+            time.sleep(0.3)
+        except ProcessLookupError:
+            break
+    else:
+        raise AssertionError("head controller survived rt down")
+
+
+def test_autoscaler_launches_through_remote_provider(launcher_spec):
+    """The scaling loop drives the SSH-shaped provider: demand for a
+    TPU slice launches one (both hosts), fulfilled demand launches
+    nothing more."""
+    os.environ["RT_AUTOSCALING_ENABLED"] = "1"
+    try:
+        state = rt_commands.up(launcher_spec, no_autoscaler=True,
+                               no_workers=True)
+        address = state["address"]
+        spec = load_cluster_spec(launcher_spec)
+        scaler = rt_commands.autoscaler_from_spec(spec, address)
+
+        ray_tpu.init(address=address)
+        ref = ray_tpu.remote(lambda: "on-slice").options(
+            num_cpus=0, resources={"slice-v5e-8": 1}).remote()
+
+        import asyncio
+
+        async def _drive():
+            scaler._cli = __import__(
+                "ray_tpu.core.rpc", fromlist=["RpcClient"]).RpcClient(
+                    address, tag="test-scaler")
+            try:
+                for _ in range(120):
+                    r = await scaler.update()
+                    if r["launched"]:
+                        return r
+                    await asyncio.sleep(0.5)
+            finally:
+                await scaler._cli.close()
+            return {"launched": []}
+
+        r = asyncio.new_event_loop().run_until_complete(_drive())
+        assert r["launched"], "autoscaler never launched the slice"
+        # The pending task schedules once the slice registers.
+        assert ray_tpu.get(ref, timeout=120) == "on-slice"
+    finally:
+        os.environ.pop("RT_AUTOSCALING_ENABLED", None)
+        ray_tpu.shutdown()
+
+
+def test_head_autoscaler_adopts_up_launched_workers(launcher_spec):
+    """The head-side scaling loop must adopt min_workers that `rt up`
+    already launched — not relaunch them onto the same hosts."""
+    state = rt_commands.up(launcher_spec, no_autoscaler=True)
+    address = state["address"]
+    assert len(state["launched"]) == 1
+    spec = load_cluster_spec(launcher_spec)
+    scaler = rt_commands.autoscaler_from_spec(spec, address)
+    provider = scaler.provider
+    # Adopted: visible as non-terminated, host removed from free pool.
+    assert len(provider.non_terminated_nodes()) == 1
+    pid = provider.non_terminated_nodes()[0]
+    assert provider.node_type_of(pid) == "cpu_worker"
+    assert provider.node_cluster_id(pid)
+
+    import asyncio
+
+    from ray_tpu.core.rpc import RpcClient
+
+    async def _one_pass():
+        scaler._cli = RpcClient(address, tag="test-scaler2")
+        try:
+            # Let the worker agent register before judging demand.
+            for _ in range(60):
+                nodes = await scaler._cli.call("list_nodes", {})
+                if sum(1 for n in nodes if n["alive"]) >= 2:
+                    break
+                await asyncio.sleep(0.5)
+            return await scaler.update()
+        finally:
+            await scaler._cli.close()
+
+    r = asyncio.new_event_loop().run_until_complete(_one_pass())
+    assert r["launched"] == [], \
+        f"adopted min_worker was double-launched: {r}"

@@ -113,3 +113,60 @@ def test_agent_exits_after_grace_without_controller():
         os.environ.pop("RT_CONTROLLER_RECONNECT_GRACE_S", None)
         if cluster is not None:
             cluster.shutdown()
+
+
+def test_controller_sigkill_mid_workload(ft_cluster):
+    """Chaos: SIGKILL the controller while a task stream and a live
+    actor workload are in flight (round-2 VERDICT item 6).  With
+    persistence on, kill+restart mid-job must lose no actors or KV and
+    the in-flight workload must complete via submitter retries."""
+    @ray_tpu.remote
+    class Accumulator:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+        def read(self):
+            return self.total
+
+    acc = Accumulator.options(name="chaos_acc",
+                              lifetime="detached").remote()
+    assert ray_tpu.get(acc.add.remote(1), timeout=60) == 1
+    from ray_tpu.core import runtime as _rm
+    rt = _rm.get_runtime()
+    rt.controller_call("kv_put", {"key": "chaos/marker", "value": b"v1"})
+    time.sleep(1.5)  # snapshot catches the actor + KV
+
+    @ray_tpu.remote
+    def work(i):
+        time.sleep(0.05)
+        return i * i
+
+    # Launch a wave, kill the controller while it's executing, keep
+    # submitting AFTER the kill (these ride the reconnect grace).
+    pre = [work.remote(i) for i in range(8)]
+    time.sleep(0.1)
+    ft_cluster.kill_controller()
+    post = [work.remote(i) for i in range(8, 12)]
+    time.sleep(1.0)
+    ft_cluster.restart_controller()
+
+    got = ray_tpu.get(pre + post, timeout=120)
+    assert got == [i * i for i in range(12)]
+
+    # Actor state and KV survived.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            a2 = ray_tpu.get_actor("chaos_acc")
+            assert ray_tpu.get(a2.read.remote(), timeout=30) == 1
+            break
+        except Exception:
+            time.sleep(0.5)
+    else:
+        raise AssertionError("detached actor lost across SIGKILL")
+    assert rt.controller_call(
+        "kv_get", {"key": "chaos/marker"}) == b"v1"

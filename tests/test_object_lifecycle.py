@@ -86,29 +86,51 @@ def rt():
     ray_tpu.shutdown()
 
 
-def _segment_path(rt, ref):
-    return f"/dev/shm/rt_{rt.session}_{ref.id.hex()}"
+def _shm_resident(rt, ref):
+    """True if the object's bytes are physically resident in this host's
+    shared memory — checks both backends: a per-object segment file
+    (segments backend) or pool-index membership (native pool backend)."""
+    if os.path.exists(f"/dev/shm/rt_{rt.session}_{ref.id.hex()}"):
+        return True
+    try:
+        from ray_tpu._native.shm_pool import ShmPool
+
+        pool = ShmPool(f"/rtpool_{rt.session}", create=False)
+        try:
+            return pool.contains(ref.id.binary())
+        finally:
+            pool.close()
+    except Exception:
+        return False
 
 
-def _wait_gone(path, timeout=15.0):
+def _wait_freed(rt, ref, timeout=15.0):
     deadline = time.time() + timeout
     while time.time() < deadline:
-        if not os.path.exists(path):
+        if not _shm_resident(rt, ref):
             return True
         time.sleep(0.1)
     return False
 
 
-def test_put_ref_drop_unlinks_segment(rt):
+class _IdProbe:
+    """Holds just the ObjectID so residency can be polled after the
+    real ObjectRef (and its distributed refcount hold) is dropped."""
+
+    def __init__(self, ref):
+        self.id = ref.id
+
+
+def test_put_ref_drop_frees_shm(rt):
     ref = ray_tpu.put(np.ones(500_000, dtype=np.float32))  # 2MB
-    path = _segment_path(rt, ref)
-    assert os.path.exists(path)
+    assert _shm_resident(rt, ref)
+    probe = _IdProbe(ref)
     del ref
     gc.collect()
-    assert _wait_gone(path), "segment not unlinked after last ref dropped"
+    assert _wait_freed(rt, probe), "shm not freed after last ref dropped"
 
 
-def test_task_result_ref_drop_unlinks_segment(rt):
+def test_task_result_ref_drop_frees_shm(rt):
     @ray_tpu.remote
     def big():
         return np.ones((800, 800), dtype=np.float32)  # 2.5MB
@@ -116,12 +138,12 @@ def test_task_result_ref_drop_unlinks_segment(rt):
     ref = big.remote()
     out = ray_tpu.get(ref, timeout=60)
     assert out.shape == (800, 800)
-    path = _segment_path(rt, ref)
-    assert os.path.exists(path)
+    assert _shm_resident(rt, ref)
+    probe = _IdProbe(ref)
     del ref
     gc.collect()
-    assert _wait_gone(path), "result segment not unlinked"
-    # The fetched value itself stays valid (mapping outlives the unlink).
+    assert _wait_freed(rt, probe), "result shm not freed"
+    # The fetched value itself stays valid (mapping outlives the free).
     assert float(out[0, 0]) == 1.0
 
 
@@ -148,11 +170,10 @@ def test_fire_and_forget_result_is_freed(rt):
         return np.ones(600_000, dtype=np.float32)
 
     ref = big.remote()
-    hexid = ref.id.hex()
-    path = f"/dev/shm/rt_{rt.session}_{hexid}"
+    probe = _IdProbe(ref)
     del ref  # dropped while (possibly) still running
     gc.collect()
-    assert _wait_gone(path, timeout=30.0)
+    assert _wait_freed(rt, probe, timeout=30.0)
 
 
 def test_returned_ref_survives_worker_frame_death(rt):
