@@ -207,11 +207,14 @@ def down(spec_path: str) -> None:
     # scaling loop may have launched nodes after `rt up` returned.
     head = make_runner(spec, spec.head_host)
     head_pids = " ".join(str(p) for p in state.get("head_pids", []))
-    session_kill = (f"pkill -f 'ray_tpu.*--session {session}' "
+    # [r]ay_tpu-style bracket: the pattern must not match the cleanup
+    # shell's OWN command line (a self-match SIGTERMs the shell before
+    # the later pkill statements run).
+    session_kill = (f"pkill -f '[r]ay_tpu.*--session {session}' "
                     "2>/dev/null; " if session else "")
     cleanup = f"kill {head_pids} 2>/dev/null; " if head_pids else ""
     cleanup += session_kill
-    cleanup += (f"pkill -f 'rt_cluster_{spec.cluster_name}.yaml' "
+    cleanup += (f"pkill -f '[r]t_cluster_{spec.cluster_name}.yaml' "
                 "2>/dev/null; true")
     try:
         head.run(cleanup, timeout=60.0, check=False)

@@ -113,6 +113,10 @@ class ClusterRuntime(BaseRuntime):
         from .object_store import create_store
 
         self.store = create_store(self.session, config)
+        if hasattr(self.store, "on_pressure"):
+            # Pool backend: a full slab asks the agent to evict/spill
+            # (make_room) instead of failing the seal.
+            self.store.on_pressure = self._request_store_room
         self.memory = MemoryStore()
         self._runtime_id = uuid.uuid4().hex[:16]
         self._ctl: Optional[RpcClient] = None
@@ -135,6 +139,10 @@ class ClusterRuntime(BaseRuntime):
         self._submitted_holds: Dict[ObjectID, int] = {}  # in-flight args
         self._owned_ids: Set[ObjectID] = set()      # ids created here
         self._owned_plane: Set[ObjectID] = set()    # owned + in the plane
+        # Owned in-band refs that were pickled OUT of this process while
+        # still pending: their values must be written through to the
+        # object plane on completion (see promote_refs_to_plane).
+        self._escaped: Set[ObjectID] = set()
         self._borrows_registered: Set[ObjectID] = set()
         self._free_on_complete: Set[ObjectID] = set()
         # Lineage: creation specs of owned plane objects, replayed when
@@ -226,8 +234,97 @@ class ClusterRuntime(BaseRuntime):
         with self._refs_lock:
             self._owned_ids.update(oids)
 
+    # ------------------------------------------ in-band -> plane promotion
+    def promote_refs_to_plane(self, oids) -> None:
+        """Write owned MEMORY-STORE-ONLY values through to the object
+        plane when their refs escape this process — pickled into task
+        args, a put payload, or a return value (ref: core_worker
+        promoting inlined small objects to plasma once their ObjectRef
+        is borrowed).  Without this, another process that receives such
+        a ref polls the object directory forever: the value exists only
+        in our address space.  Still-pending refs are remembered and
+        promoted when their result arrives (_accept_returns)."""
+        for oid in oids:
+            # Order matters (TOCTOU): set the promotion promise FIRST,
+            # then look for the value.  Whichever side sees both the
+            # value and the promise does the write-through — a result
+            # landing between our steps is promoted by the completion
+            # path (which stores the value before reading _escaped
+            # under the same lock).  Double promotion is idempotent.
+            with self._refs_lock:
+                if oid not in self._owned_ids or \
+                        oid in self._owned_plane:
+                    continue
+                self._escaped.add(oid)
+            ok, val = self.memory.get_nowait(oid)
+            if not ok:
+                continue  # pending: completion path fulfils the promise
+            with self._refs_lock:
+                self._escaped.discard(oid)
+            if isinstance(val, (_StoreRef, TaskError)):
+                continue
+            self._write_through(oid, val)
+
+    def _write_through(self, oid: ObjectID, val: Any) -> None:
+        try:
+            size = self.store.create_and_seal(oid, val)
+        except Exception:
+            logger.warning("in-band promotion of %s failed",
+                           oid.hex()[:12], exc_info=True)
+            return
+        with self._refs_lock:
+            self._owned_plane.add(oid)
+        from .rpc import spawn_task
+
+        async def _register():
+            try:
+                await self._agent.call("register_object",
+                                       {"object_id": oid, "size": size})
+            except Exception:
+                pass  # consumers keep polling; next heartbeat re-syncs
+
+        # Fire-and-forget: callers may already be ON the io loop
+        # (completion path), so never block on it here.
+        self.io.call_soon(lambda: spawn_task(_register(), self.io.loop))
+
+    @staticmethod
+    def _scan_embedded_refs(values) -> List[ObjectID]:
+        """Ids of ObjectRefs nested anywhere inside ``values`` (one
+        cloudpickle pass with the ref collector active)."""
+        import cloudpickle
+
+        from .object_ref import collect_embedded_refs
+
+        interesting = [v for v in values
+                       if not isinstance(v, (int, float, str, bytes,
+                                             bool, type(None)))]
+        if not interesting:
+            return []
+        with collect_embedded_refs() as found:
+            try:
+                # buffer_callback keeps large binary payloads (numpy
+                # etc.) out-of-band and UNCOPIED — this pass only needs
+                # the ref collector side effect, not the bytes.
+                cloudpickle.dumps(interesting, protocol=5,
+                                  buffer_callback=lambda _b: None)
+            except Exception:
+                return []
+        return list(found)
+
     def _store_result_value(self, oid: ObjectID, value: Any) -> None:
         self.memory.put(oid, value)
+        with self._refs_lock:
+            escaped = oid in self._escaped
+            self._escaped.discard(oid)
+        if escaped and not isinstance(value, (_StoreRef, TaskError)):
+            # A ref to this (then-pending) value left the process;
+            # fulfil the promotion promise now that the value exists.
+            # Off-loop: this path runs on the io loop and the seal can
+            # ride store backpressure.
+            loop = self.io.loop
+            self.io.call_soon(
+                lambda: loop.run_in_executor(None, self._write_through,
+                                             oid, value))
         with self._pending_lock:
             self._pending_returns.discard(oid)
         ev = self._completion_events.get(oid)
@@ -350,13 +447,19 @@ class ClusterRuntime(BaseRuntime):
         return cli
 
     async def _event_poll_loop(self):
-        """Long-poll controller pubsub to invalidate actor caches (ref:
-        src/ray/pubsub long-poll subscriber)."""
+        """Long-poll controller pubsub to invalidate actor caches and
+        stream this job's worker logs to the console (ref:
+        src/ray/pubsub long-poll subscriber + log_monitor.py driver
+        streaming)."""
+        channels = ["actor", "node"]
+        stream_logs = getattr(self.config, "log_to_driver", True)
+        if stream_logs:
+            channels.append("worker_logs")
         while not self._shutdown_flag:
             try:
                 r = await self._ctl.call("poll_events", {
                     "cursor": self._event_cursor,
-                    "channels": ["actor", "node"], "timeout": 10.0},
+                    "channels": channels, "timeout": 10.0},
                     timeout=15.0)
             except (RpcError, asyncio.TimeoutError, RemoteCallError):
                 await asyncio.sleep(0.5)
@@ -376,6 +479,21 @@ class ClusterRuntime(BaseRuntime):
                     if cached is not None:
                         cached["state"] = data["state"]
                         cached["worker_addr"] = data.get("worker_addr", "")
+                elif ch == "worker_logs" and stream_logs:
+                    self._print_worker_logs(data)
+
+    def _print_worker_logs(self, rec) -> None:
+        """Print a worker-log batch belonging to THIS job, tagged like
+        the reference's ``(pid=..., ip=...)`` prefix."""
+        if rec.get("job_id") != self.job_id.hex():
+            return
+        prefix = (f"({rec.get('pid')}, "
+                  f"node={str(rec.get('node_id', ''))[:8]}) ")
+        out = "".join(prefix + line + "\n"
+                      for line in rec.get("lines", []))
+        if out:
+            sys.stdout.write(out)
+            sys.stdout.flush()
 
     # ------------------------------------------------- dependency resolution
     async def _resolve_deps(self, spec: TaskSpec,
@@ -419,6 +537,10 @@ class ClusterRuntime(BaseRuntime):
         held = [a.object_id for a in spec.args
                 if a.kind == ArgKind.OBJECT_REF and a.object_id is not None]
         self._add_submitted_holds(held)
+        embedded = self._scan_embedded_refs(
+            [a.value for a in spec.args if a.kind == ArgKind.VALUE])
+        if embedded:
+            self.promote_refs_to_plane(embedded)
         sub = _Submission(spec)
         for oid in oids:
             self._submissions[oid] = sub
@@ -592,6 +714,7 @@ class ClusterRuntime(BaseRuntime):
             "resources": dict(spec.resources.amounts),
             "strategy": spec.scheduling.kind,
             "request_id": sub.request_id,
+            "job_id": spec.job_id.hex(),
         }
         renv_wire = await self._runtime_env_payload(spec)
         if renv_wire is not None:
@@ -769,6 +892,7 @@ class ClusterRuntime(BaseRuntime):
                 "resources": dict(spec.resources.amounts),
                 "strategy": spec.scheduling.kind,
                 "is_actor": True, "actor_id": spec.actor_id,
+                "job_id": spec.job_id.hex(),
             }
             renv_wire = await self._runtime_env_payload(spec)
             if renv_wire is not None:
@@ -821,6 +945,10 @@ class ClusterRuntime(BaseRuntime):
         held = [a.object_id for a in spec.args
                 if a.kind == ArgKind.OBJECT_REF and a.object_id is not None]
         self._add_submitted_holds(held)
+        embedded = self._scan_embedded_refs(
+            [a.value for a in spec.args if a.kind == ArgKind.VALUE])
+        if embedded:
+            self.promote_refs_to_plane(embedded)
         self.io.call_soon(lambda: self.io.loop.create_task(
             self._submit_actor(spec, held)))
         return [ObjectRef(o) for o in oids]
@@ -939,8 +1067,17 @@ class ClusterRuntime(BaseRuntime):
 
     # ------------------------------------------------------------- objects
     def put(self, value: Any) -> ObjectRef:
+        from . import serialization
+        from .object_ref import collect_embedded_refs
+
         oid = ObjectID.for_put(self.current_task_id(), self.next_put_index())
-        size = self.store.create_and_seal(oid, value)
+        with collect_embedded_refs() as embedded:
+            payload, views = serialization.serialize(value)
+        if embedded:
+            # Refs nested in a put payload escape to whoever gets the
+            # container: their in-band values must be pullable.
+            self.promote_refs_to_plane(list(embedded))
+        size = self.store.seal_parts(oid, payload, views)
         with self._refs_lock:
             self._owned_ids.add(oid)
             self._owned_plane.add(oid)  # puts have no lineage (ref parity)
@@ -1061,14 +1198,17 @@ class ClusterRuntime(BaseRuntime):
     def get(self, refs: List[ObjectRef],
             timeout: Optional[float]) -> List[Any]:
         deadline = time.monotonic() + timeout if timeout is not None else None
-        # Figure out which refs need waiting; release CPU while blocked.
+        # Release this worker's lease CPU while blocked on ANY ref that
+        # is not already local — including refs owned by OTHER processes
+        # (ref: core_worker NotifyDirectCallTaskBlocked).  Scoping this
+        # to our own pending returns deadlocks a fixed-size worker pool:
+        # a task get()ing another owner's not-yet-produced object holds
+        # its lease while the producing task queues behind it forever.
         needs_wait = []
         for r in refs:
             ok, _ = self.memory.get_nowait(r.id)
             if not ok:
-                with self._pending_lock:
-                    if r.id in self._pending_returns:
-                        needs_wait.append(r.id)
+                needs_wait.append(r.id)
         blocked = bool(needs_wait)
         if blocked:
             self._notify_blocked(True)
@@ -1190,6 +1330,14 @@ class ClusterRuntime(BaseRuntime):
                     not_ready = still_remote + not_ready
                     break
         return ready, not_ready
+
+    def _request_store_room(self, nbytes: int) -> None:
+        """Seal-backpressure hook (any thread): ask the local agent to
+        evict/spill ``nbytes`` of store headroom, synchronously."""
+        if self._agent is None:
+            return
+        self.io.run(self._agent.call("make_room", {"bytes": nbytes}),
+                    timeout=30.0)
 
     def _locally_resident(self, refs: List[ObjectRef]) -> set:
         """Subset of ``refs`` whose values are resident on this node

@@ -96,6 +96,10 @@ define_flag("metrics_report_period_s", float, 5.0,
 define_flag("task_event_buffer_size", int, 10000,
             "Max buffered per-task lifecycle events before drop-oldest.")
 define_flag("tracing_enabled", bool, False, "Emit task/actor spans.")
+define_flag("log_to_driver", bool, True,
+            "Tail worker stdout/stderr on each node agent and stream "
+            "the lines to the submitting driver's console (ref: "
+            "_private/log_monitor.py).")
 define_flag("memory_usage_threshold", float, 0.95,
             "Host memory-usage fraction above which the OOM monitor "
             "kills workers running retriable work.")

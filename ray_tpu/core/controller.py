@@ -116,7 +116,7 @@ class Controller:
             "list_actors", "cluster_shutdown", "ping", "drain_node",
             "task_events", "list_tasks", "get_task", "list_objects",
             "list_jobs", "report_metrics", "metrics_text",
-            "get_load_metrics",
+            "get_load_metrics", "worker_logs",
         ]:
             self.server.register(name, getattr(self, name))
 
@@ -647,6 +647,14 @@ class Controller:
 
     # ------------------------------------------------------------------ jobs
     # ----------------------------------------------------- task events
+    async def worker_logs(self, p):
+        """Batched worker log lines from node-agent tailers; fanned to
+        drivers over the worker_logs pubsub channel (ref:
+        log_monitor.py lines -> GCS pubsub -> driver print)."""
+        for rec in p.get("batch", []):
+            self._publish("worker_logs", rec)
+        return {"ok": True}
+
     async def task_events(self, p):
         """Batched task state transitions from workers (ref:
         task_event_buffer.h:222 flush -> gcs_task_manager.h:86)."""

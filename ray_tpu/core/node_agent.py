@@ -52,6 +52,11 @@ class WorkerEntry:
     # Runtime-env identity: a worker only serves leases with a matching
     # env hash (ref: worker_pool.h:216 PopWorker runtime-env keying).
     env_hash: str = ""
+    # Log plane: this worker's stdout/stderr file and the job its
+    # current/last lease belongs to (log lines are attributed to it —
+    # ref: _private/log_monitor.py job tagging).
+    log_path: str = ""
+    job_id: Optional[str] = None
 
 
 @dataclass
@@ -141,11 +146,13 @@ class NodeAgent:
             "report_task_events", "report_metrics",
             "task_blocked", "task_unblocked",
             "register_object", "pull_object", "fetch_raw", "fetch_chunk",
-            "delete_object",
+            "delete_object", "make_room",
             "object_exists", "objects_exist", "store_stats",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "restart_actor", "kill_worker", "report_actor_failure",
             "drain", "shutdown", "ping", "node_info", "list_workers",
+            "list_worker_logs", "read_worker_log", "profile_worker",
+            "stack_worker",
         ]:
             self.server.register(name, getattr(self, name))
 
@@ -155,13 +162,29 @@ class NodeAgent:
         # task with its await stack (coroutine-level triage the
         # faulthandler thread dump can't see).
         def _dump_tasks(*_a):
-            import traceback
-
             for t in asyncio.all_tasks():
-                stack = t.get_stack()
-                frames = "".join(traceback.format_stack(stack[-1])) \
-                    if stack else "  <no frames>"
-                logger.error("TASKDUMP %r\n%s", t, frames)
+                # Walk the cr_await chain so nested handler coroutines
+                # show their INNERMOST suspension point, not just the
+                # outer _dispatch frame.
+                lines = []
+                coro = t.get_coro()
+                seen = 0
+                while coro is not None and seen < 32:
+                    seen += 1
+                    frame = getattr(coro, "cr_frame", None) or \
+                        getattr(coro, "gi_frame", None)
+                    if frame is not None:
+                        code = frame.f_code
+                        lines.append(f"  {code.co_filename}:"
+                                     f"{frame.f_lineno} "
+                                     f"{code.co_name}")
+                    nxt = getattr(coro, "cr_await", None) or \
+                        getattr(coro, "gi_yieldfrom", None)
+                    if nxt is coro:
+                        break
+                    coro = nxt
+                logger.error("TASKDUMP %r\n%s", t,
+                             "\n".join(lines) or "  <no frames>")
 
         try:
             asyncio.get_event_loop().add_signal_handler(
@@ -196,6 +219,8 @@ class NodeAgent:
             "is_head": self.is_head})
         spawn_task(self._heartbeat_loop())
         spawn_task(self._reap_loop())
+        if self.config.log_to_driver:
+            spawn_task(self._log_monitor_loop())
         if self.config.memory_monitor_refresh_ms > 0:
             spawn_task(self._memory_monitor_loop())
         for _ in range(self.config.worker_pool_min_workers):
@@ -388,14 +413,17 @@ class NodeAgent:
                                "logs")
         os.makedirs(log_dir, exist_ok=True)
         self._starting_workers += 1
-        out = open(os.path.join(
+        log_path = os.path.join(
             log_dir, f"worker-{self.node_id.hex()[:8]}-"
-            f"{self._starting_workers}-{time.time():.0f}.log"), "ab")
+            f"{self._starting_workers}-{time.time():.0f}.log")
+        out = open(log_path, "ab")
         proc = subprocess.Popen(
             [sys.executable, "-u", "-m", "ray_tpu.core.worker_main"],
             env=env, stdout=out, stderr=subprocess.STDOUT,
             start_new_session=True)
         out.close()
+        self._worker_log_paths = getattr(self, "_worker_log_paths", {})
+        self._worker_log_paths[proc.pid] = log_path
         self._spawned_procs.append(proc)
         self._pending_spawns = getattr(self, "_pending_spawns", {})
         self._pending_spawns[proc.pid] = (proc, env_hash)
@@ -415,7 +443,9 @@ class NodeAgent:
             p["pid"], (None, ""))
         w = WorkerEntry(
             worker_id=p["worker_id"], addr=p["addr"], pid=p["pid"],
-            proc=pending[0], state="idle", env_hash=pending[1])
+            proc=pending[0], state="idle", env_hash=pending[1],
+            log_path=getattr(self, "_worker_log_paths",
+                             {}).get(p["pid"], ""))
         self.workers[w.worker_id] = w
         self._starting_done(w.env_hash)
         self._idle_q.append(w)
@@ -626,6 +656,8 @@ class NodeAgent:
             bundle_index=payload.get("bundle_index", -1))
         w.state = "actor" if payload.get("is_actor") else "leased"
         w.lease_id = lease.lease_id
+        if payload.get("job_id"):
+            w.job_id = payload["job_id"]
         if payload.get("actor_id") is not None:
             w.actor_id = payload["actor_id"]
         self.leases[lease.lease_id] = lease
@@ -1123,6 +1155,15 @@ class NodeAgent:
         return {"objects": n, "used_bytes": used, "capacity_bytes": cap,
                 **self.directory.spill_stats()}
 
+    async def make_room(self, p):
+        """Producer backpressure relief: evict/spill until the caller's
+        byte need fits (ref: plasma CreateRequestQueue).  Spill IO is
+        blocking — run off the RPC loop."""
+        nbytes = int(p.get("bytes", 0))
+        evicted = await asyncio.get_event_loop().run_in_executor(
+            None, self.directory.make_room, nbytes)
+        return {"ok": True, "evicted": len(evicted)}
+
     # -------------------------------------------------- placement bundles
     async def prepare_bundle(self, p):
         key = (p["pg_id"], p["bundle_index"])
@@ -1242,6 +1283,147 @@ class NodeAgent:
              "actor_id": w.actor_id.hex() if w.actor_id else None}
             for w in self.workers.values()]}
 
+    # ------------------------------------------------------------ log plane
+    async def _log_monitor_loop(self) -> None:
+        """Tail every worker's log file; publish new lines to the
+        controller's worker_logs pubsub channel, job-tagged, so the
+        submitting driver can print them (ref: _private/
+        log_monitor.py:103 — per-node tailer, redesigned as an agent
+        coroutine instead of a separate process)."""
+        offsets: Dict[str, int] = {}
+        # path -> (pid, worker_id hex, job_id); sticky so a dead
+        # worker's final lines still drain with their last-known tags.
+        meta: Dict[str, tuple] = {}
+        # path -> consecutive no-data ticks while its worker is dead;
+        # fully-drained dead entries are dropped so the tail set stays
+        # bounded under worker churn.
+        idle_dead: Dict[str, int] = {}
+        while True:
+            await asyncio.sleep(0.5)
+            batch = []
+            advances: List[tuple] = []  # (path, new_offset) on success
+            live_pids = set()
+            for w in self.workers.values():
+                live_pids.add(w.pid)
+                if w.log_path:
+                    meta[w.log_path] = (w.pid, w.worker_id.hex(),
+                                        w.job_id)
+            for pid, path in getattr(self, "_worker_log_paths",
+                                     {}).items():
+                meta.setdefault(path, (pid, None, None))
+            for path, (pid, wid, job) in list(meta.items()):
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(offsets.get(path, 0))
+                        data = f.read(256 * 1024)
+                except OSError:
+                    data = b""
+                # Only complete lines; partial tail re-read next tick.
+                nl = data.rfind(b"\n") if data else -1
+                if nl < 0:
+                    if pid not in live_pids:
+                        idle_dead[path] = idle_dead.get(path, 0) + 1
+                        if idle_dead[path] >= 6:  # ~3s fully drained
+                            meta.pop(path, None)
+                            offsets.pop(path, None)
+                            idle_dead.pop(path, None)
+                            getattr(self, "_worker_log_paths",
+                                    {}).pop(pid, None)
+                    continue
+                idle_dead.pop(path, None)
+                lines = data[:nl].decode("utf-8",
+                                         "replace").splitlines()
+                advances.append((path, offsets.get(path, 0) + nl + 1))
+                batch.append({"node_id": self.node_id.hex(),
+                              "worker_id": wid, "pid": pid,
+                              "job_id": job, "lines": lines})
+            if batch:
+                try:
+                    await self._ctl.call("worker_logs",
+                                         {"batch": batch})
+                except Exception:
+                    # Controller unreachable / handler error: do NOT
+                    # advance offsets — the batch re-sends next tick
+                    # instead of silently dropping, and ANY exception
+                    # must not kill the tailer for the agent's life.
+                    continue
+                for path, off in advances:
+                    offsets[path] = off
+
+    def _worker_by_ref(self, p) -> Optional[WorkerEntry]:
+        """Resolve a worker by worker_id hex (prefix ok) or pid."""
+        wid, pid = p.get("worker_id"), p.get("pid")
+        for w in self.workers.values():
+            if pid is not None and w.pid == int(pid):
+                return w
+            if wid and w.worker_id.hex().startswith(wid):
+                return w
+        return None
+
+    async def list_worker_logs(self, _p):
+        out = []
+        known = {w.pid: w for w in self.workers.values()}
+        for pid, path in getattr(self, "_worker_log_paths",
+                                 {}).items():
+            w = known.get(pid)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = -1
+            out.append({"pid": pid, "path": path, "size": size,
+                        "worker_id": w.worker_id.hex() if w else None,
+                        "state": w.state if w else "dead",
+                        "job_id": w.job_id if w else None})
+        return {"logs": out}
+
+    async def read_worker_log(self, p):
+        """Tail a worker's log file — works for DEAD workers too (the
+        file outlives the process; ref: dashboard/modules/log/)."""
+        path = None
+        w = self._worker_by_ref(p)
+        if w is not None:
+            path = w.log_path
+        elif p.get("pid") is not None:
+            path = getattr(self, "_worker_log_paths",
+                           {}).get(int(p["pid"]))
+        if not path:
+            return {"ok": False, "error": "unknown worker"}
+        max_bytes = int(p.get("max_bytes", 256 * 1024))
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(max(0, size - max_bytes))
+                data = f.read(max_bytes)
+        except OSError as e:
+            return {"ok": False, "error": str(e)}
+        return {"ok": True, "path": path,
+                "text": data.decode("utf-8", "replace")}
+
+    async def profile_worker(self, p):
+        """Sampling-profile a live worker (ref: profile_manager.py:121
+        py-spy record — in-process sampler, see util/profiling.py)."""
+        w = self._worker_by_ref(p)
+        if w is None:
+            return {"ok": False, "error": "unknown worker"}
+        cli = RpcClient(w.addr, tag="profile")
+        try:
+            return await cli.call(
+                "profile", {"duration_s": p.get("duration_s", 2.0),
+                            "hz": p.get("hz", 100.0)},
+                )
+        finally:
+            await cli.close()
+
+    async def stack_worker(self, p):
+        w = self._worker_by_ref(p)
+        if w is None:
+            return {"ok": False, "error": "unknown worker"}
+        cli = RpcClient(w.addr, tag="stack")
+        try:
+            return await cli.call("dump_stack", {})
+        finally:
+            await cli.close()
+
     async def node_info(self, _p):
         return {"node_id": self.node_id, "addr": self.server.address,
                 "total": dict(self.total.amounts),
@@ -1284,6 +1466,9 @@ class NodeAgent:
 
 
 def main() -> None:
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--session", required=True)

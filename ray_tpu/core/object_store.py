@@ -227,11 +227,16 @@ class PoolObjectStore:
     # may overshoot (same policy as the segment backend), and slab
     # pages are only backed when touched, so slack is nearly free.
     SLACK = 4
+    # How long a producer rides seal backpressure before giving up.
+    SEAL_PRESSURE_TIMEOUT_S = 60.0
 
     def __init__(self, session: str, capacity_bytes: int):
         from .._native.shm_pool import ShmPool
 
         self._session = session
+        # Optional hook: called with the needed byte count when the
+        # slab is full, so the owner can trigger agent-side eviction.
+        self.on_pressure = None
         self._pool = ShmPool(f"/rtpool_{session}",
                              slab_bytes=capacity_bytes * self.SLACK,
                              table_slots=1 << 16)
@@ -248,12 +253,33 @@ class PoolObjectStore:
     def seal_parts(self, oid: ObjectID, payload: bytes, views) -> int:
         size = serialization.packed_size(payload, views)
         key = self._key(oid)
-        buf = self._pool.alloc(key, size)
-        if buf is None:
-            self._pool.delete(key)  # replace a stale sealed copy
+        # Create backpressure, not hard failure (ref: plasma
+        # CreateRequestQueue): when the slab is full — e.g. many
+        # producers sealing before the agent's directory has
+        # evicted/spilled — ask the agent to make room (on_pressure
+        # hook, wired by the runtime to the agent's make_room RPC) and
+        # retry with backoff until the deadline.
+        deadline = time.monotonic() + self.SEAL_PRESSURE_TIMEOUT_S
+        delay = 0.02
+        while True:
             buf = self._pool.alloc(key, size)
             if buf is None:
-                raise OSError(f"shm pool full sealing {oid.hex()}")
+                self._pool.delete(key)  # replace a stale sealed copy
+                buf = self._pool.alloc(key, size)
+            if buf is not None:
+                break
+            if time.monotonic() >= deadline:
+                raise OSError(f"shm pool full sealing {oid.hex()} "
+                              f"({size}B after "
+                              f"{self.SEAL_PRESSURE_TIMEOUT_S}s of "
+                              "backpressure)")
+            if self.on_pressure is not None:
+                try:
+                    self.on_pressure(size)
+                except Exception:
+                    pass  # agent unreachable: plain backoff still helps
+            time.sleep(delay)
+            delay = min(delay * 2, 0.5)
         pos = 0
         buf[pos:pos + 4] = len(views).to_bytes(4, "little"); pos += 4
         buf[pos:pos + 8] = len(payload).to_bytes(8, "little"); pos += 8
@@ -390,17 +416,29 @@ class StoreDirectory:
             self._used += size
         return self._shed_pressure(protect=oid)
 
-    def _shed_pressure(self, protect: Optional[ObjectID]) -> List[ObjectID]:
+    def make_room(self, nbytes: int) -> List[ObjectID]:
+        """Shed until ``nbytes`` of headroom exists below capacity —
+        producer-driven backpressure relief (ref: plasma
+        CreateRequestQueue draining the eviction policy): a worker
+        whose seal hit a full slab asks its agent to evict/spill NOW
+        instead of failing the task."""
+        target = max(0, self._capacity - int(nbytes))
+        return self._shed_pressure(protect=None, target_used=target)
+
+    def _shed_pressure(self, protect: Optional[ObjectID],
+                       target_used: Optional[int] = None
+                       ) -> List[ObjectID]:
         """Evict unpinned secondaries, then spill pinned primaries,
-        until under capacity.  Victims (and their per-object IO claim)
-        are taken under the lock; the spill IO runs outside it.  Entries
-        with transient read pins or an active IO claim are never
-        touched.  Evicted ids also flow to ``on_evict`` so the control
-        plane drops their locations."""
+        until under capacity (or ``target_used``).  Victims (and their
+        per-object IO claim) are taken under the lock; the spill IO
+        runs outside it.  Entries with transient read pins or an
+        active IO claim are never touched.  Evicted ids also flow to
+        ``on_evict`` so the control plane drops their locations."""
+        limit = self._capacity if target_used is None else target_used
         evicted: List[ObjectID] = []
         to_spill: List[StoredObject] = []
         with self._lock:
-            while self._used > self._capacity:
+            while self._used > limit:
                 victim = None
                 for vid, ent in self._entries.items():
                     if vid != protect and not ent.spilled \
@@ -509,12 +547,24 @@ class StoreDirectory:
                 self._store.put_raw(oid, data)
             except OSError:
                 # Pool backend can report full (fragmentation / shared
-                # slab): shed and retry once before giving up.
-                self._shed_pressure(protect=oid)
-                try:
-                    self._store.put_raw(oid, data)
-                except OSError:
-                    return False
+                # slab, transient read-window pins): shed and retry
+                # with backoff — a false "lost" here surfaces as
+                # ObjectLostError for an object that is safely on disk.
+                deadline = time.time() + 30.0
+                delay = 0.05
+                while True:
+                    self._shed_pressure(protect=oid,
+                                        target_used=max(
+                                            0, self._capacity
+                                            - len(data)))
+                    try:
+                        self._store.put_raw(oid, data)
+                        break
+                    except OSError:
+                        if time.time() >= deadline:
+                            return False
+                        time.sleep(delay)
+                        delay = min(delay * 2, 1.0)
             with self._lock:
                 ent = self._entries.get(oid)
                 if ent is None:

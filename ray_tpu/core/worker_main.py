@@ -77,7 +77,8 @@ class Worker:
         self._event_buf: List[Dict] = []
         self._event_lock = threading.Lock()
         for name in ["push_task", "create_actor", "push_actor_task",
-                     "cancel_task", "ping", "exit"]:
+                     "cancel_task", "ping", "exit", "dump_stack",
+                     "profile"]:
             self.server.register(name, getattr(self, name))
 
     async def start(self) -> None:
@@ -240,6 +241,11 @@ class Worker:
         for oid, value in zip(oids, values):
             with collect_embedded_refs() as embedded:
                 payload, views = serialization.serialize(value)
+            if embedded:
+                # Any of our own in-band values whose refs ride in this
+                # return must become pullable by the receiver (in-band ->
+                # plane promotion; see cluster_runtime.py).
+                self.runtime.promote_refs_to_plane(list(embedded))
             size = serialization.packed_size(payload, views)
             if size <= self.config.object_inline_max_bytes:
                 buf = bytearray(size)
@@ -464,6 +470,24 @@ class Worker:
     async def exit(self, _p):
         self._exit_event.set()
         return {"ok": True}
+
+    async def dump_stack(self, _p):
+        """All-thread stack dump (ref: profile_manager.py py-spy
+        --dump, redesigned in-process — see util/profiling.py)."""
+        from ..util.profiling import dump_stacks
+
+        return {"ok": True, "stacks": dump_stacks()}
+
+    async def profile(self, p):
+        """Sampling profile of this worker's threads; returns folded
+        stacks.  Runs in a thread so the RPC loop stays responsive."""
+        from ..util.profiling import sample_profile
+
+        duration = min(float(p.get("duration_s", 2.0)), 60.0)
+        hz = min(float(p.get("hz", 100.0)), 500.0)
+        folded = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: sample_profile(duration, hz))
+        return {"ok": True, "folded": folded}
 
     async def run_forever(self):
         await self._exit_event.wait()

@@ -112,6 +112,49 @@ def create_app(address: Optional[str] = None):
         return web.Response(text=await call(state_api.metrics_text),
                             content_type="text/plain")
 
+    def _sel(req):
+        kw = {}
+        if req.query.get("worker"):
+            kw["worker_id"] = req.query["worker"]
+        if req.query.get("pid"):
+            kw["pid"] = int(req.query["pid"])
+        if req.query.get("node"):
+            kw["node_id"] = req.query["node"]
+        return kw
+
+    async def logs(req):
+        """/api/logs — inventory; /api/logs?worker=..|pid=.. — tail
+        (ref: dashboard/modules/log/)."""
+        kw = _sel(req)
+        if "worker_id" in kw or "pid" in kw:
+            text = await call(state_api.get_log, **kw)
+            return web.Response(text=text, content_type="text/plain")
+        return web.json_response(json.loads(json.dumps(
+            await call(state_api.list_logs, **kw), default=repr)))
+
+    async def stack(req):
+        """/api/stack?worker=..|pid=.. — live thread dump (ref:
+        profile_manager.py py-spy --dump role)."""
+        text = await call(state_api.stack_worker, **_sel(req))
+        return web.Response(text=text, content_type="text/plain")
+
+    async def profile(req):
+        """/api/profile?worker=..&duration=2 — sampling profile of a
+        live worker rendered as an SVG flamegraph (ref:
+        profile_manager.py:121); &format=folded for the raw stacks."""
+        from ..util.profiling import render_flamegraph_svg
+
+        kw = _sel(req)
+        duration = float(req.query.get("duration", 2.0))
+        folded = await call(state_api.profile_worker,
+                            duration_s=duration, **kw)
+        if req.query.get("format") == "folded":
+            text = "\n".join(f"{k} {v}" for k, v in folded.items())
+            return web.Response(text=text, content_type="text/plain")
+        svg = render_flamegraph_svg(
+            folded, title=f"worker {kw.get('worker_id') or kw.get('pid')}")
+        return web.Response(text=svg, content_type="image/svg+xml")
+
     app = web.Application()
     app.router.add_get("/", index)
     app.router.add_get("/api/nodes", nodes)
@@ -119,6 +162,9 @@ def create_app(address: Optional[str] = None):
     app.router.add_get("/api/tasks", tasks)
     app.router.add_get("/api/jobs", jobs)
     app.router.add_get("/api/objects", objects)
+    app.router.add_get("/api/logs", logs)
+    app.router.add_get("/api/stack", stack)
+    app.router.add_get("/api/profile", profile)
     app.router.add_get("/metrics", metrics)
     return app
 

@@ -208,13 +208,21 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _flash_backward(res, g, *, scale, bq, bk, causal, interpret):
+def _flash_backward(res, g, *, scale, bq, bk, causal, interpret,
+                    dlse=None):
     q, k, v, out, lse = res
     do = g
     bh, t, d = q.shape
     # delta_i = rowsum(dO_i * O_i) — cheap, fused by XLA.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                                  # (bh, t)
+    if dlse is not None:
+        # Cotangent flowing into the exposed log-sum-exp output (ring
+        # attention's merge weights): d(lse_i)/d(s_ij) = p_ij, so the
+        # per-row dlse term enters ds = p*(dp - delta + dlse) — i.e.
+        # exactly like delta with opposite sign.  Fold it in here so
+        # the two backward kernels need no changes.
+        delta = delta - dlse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[:, None, :], lse.shape)    # (bh, 8, t)
     nq, nk = pl.cdiv(t, bq), pl.cdiv(t, bk)
 
@@ -290,6 +298,63 @@ def _flash_bhtd_bwd(scale, bq, bk, causal, interpret, res, g):
 
 
 _flash_bhtd.defvjp(_flash_bhtd_fwd, _flash_bhtd_bwd)
+
+
+# ------------------------------------------- partial (lse-exposing) op
+# Same kernels, but the row-wise log-sum-exp is a real (differentiable)
+# output: ring attention merges per-ring-step partial outputs with
+# lse-derived weights (see parallel/ring_attention.py).
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhtd_lse(q, k, v, scale, bq, bk, causal, interpret):
+    out, lse = _flash_forward(q, k, v, scale=scale, bq=bq, bk=bk,
+                              causal=causal, interpret=interpret)
+    return out, lse[:, 0, :]
+
+
+def _flash_bhtd_lse_fwd(q, k, v, scale, bq, bk, causal, interpret):
+    out, lse = _flash_forward(q, k, v, scale=scale, bq=bq, bk=bk,
+                              causal=causal, interpret=interpret)
+    return (out, lse[:, 0, :]), (q, k, v, out, lse)
+
+
+def _flash_bhtd_lse_bwd(scale, bq, bk, causal, interpret, res, g):
+    do, dlse = g
+    return _flash_backward(res, do, scale=scale, bq=bq, bk=bk,
+                           causal=causal, interpret=interpret,
+                           dlse=dlse)
+
+
+_flash_bhtd_lse.defvjp(_flash_bhtd_lse_fwd, _flash_bhtd_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = True,
+                             block_q: int = 256, block_k: int = 256,
+                             interpret: bool | None = None):
+    """Flash attention that also returns the row log-sum-exp.
+
+    q, k, v: [B, T, H, D] -> (out [B, T, H, D], lse [B, T, H] fp32).
+    The lse output is differentiable (its cotangent folds into the
+    backward's delta term), which makes this the building block for
+    blockwise/ring attention merges."""
+    b, t, h, d = q.shape
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"seq len {t} must divide block sizes "
+                         f"({block_q}, {block_k})")
+    scale = d ** -0.5
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    out, lse = _flash_bhtd_lse(fold(q), fold(k), fold(v), scale,
+                               block_q, block_k, causal, interpret)
+    out = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, t).transpose(0, 2, 1)
+    return out, lse
 
 
 def flash_attention(q, k, v, *, causal: bool = True,

@@ -57,13 +57,38 @@ def _merge(num, den, m, num2, den2, m2):
 
 def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
                    scale: Optional[float] = None,
-                   checkpoint_steps: bool = True):
+                   checkpoint_steps: Optional[bool] = None,
+                   impl: str = "flash",
+                   block_q: int = 256, block_k: int = 256):
     """Attention over a sequence sharded on ``axis_name``.
 
     Must be called inside shard_map (or pmap) with q/k/v local shards of
     shape [batch, seq_local, heads, head_dim].  Returns the local output
     shard, same shape/dtype as q.
+
+    ``impl="flash"`` (default) computes each ring step's blockwise
+    attention with the Pallas flash kernel (ops/flash_attention.py) and
+    merges normalized partials by log-sum-exp weights, so long-context
+    SP runs at flash throughput; the ppermute of the next K/V block is
+    issued before the step's kernel, letting XLA overlap the ICI
+    transfer with MXU compute.  ``impl="lax"`` keeps the plain-lax
+    online-softmax path (reference semantics / debugging).
+
+    ``checkpoint_steps`` defaults per impl: False for flash (the
+    kernel's custom vjp already keeps only O(seq_local) residuals per
+    step — k/v blocks, partial out, lse — so remat would just rerun
+    the forward kernel in the backward for nothing) and True for lax
+    (whose step materializes [Tq, Tk] score blocks).
     """
+    if impl == "flash":
+        if checkpoint_steps is None:
+            checkpoint_steps = False
+        return _ring_attention_flash(q, k, v, axis_name=axis_name,
+                                     causal=causal, scale=scale,
+                                     checkpoint_steps=checkpoint_steps,
+                                     block_q=block_q, block_k=block_k)
+    if checkpoint_steps is None:
+        checkpoint_steps = True
     n = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
@@ -96,6 +121,82 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
         step, ((k, v), num0, den0, m0), jnp.arange(n))
     den = jnp.where(den == 0.0, 1.0, den)
     out = num / den[..., None]
+    return out.astype(q.dtype)
+
+
+def _ring_attention_flash(q, k, v, *, axis_name: str, causal: bool,
+                          scale: Optional[float],
+                          checkpoint_steps: bool,
+                          block_q: int, block_k: int):
+    """Flash-kernel ring attention (round-2 VERDICT item 3).
+
+    Each ring step runs the Pallas kernel on (Q_local, KV_block) and
+    merges NORMALIZED partial outputs with their log-sum-exps:
+        lse' = logaddexp(lse_acc, lse_blk)
+        out' = out_acc*exp(lse_acc-lse') + out_blk*exp(lse_blk-lse')
+    Block-level causality is decided per step (src ring position vs our
+    rank): blocks strictly before us are dense, our own block is
+    in-kernel causal, blocks after us are skipped — a lax.switch, so
+    the skipped branch costs nothing on device.
+    """
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    n = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    if scale is not None and abs(scale - d ** -0.5) > 1e-9:
+        raise ValueError("flash impl uses the standard 1/sqrt(d) scale")
+
+    def partial_flash(k_blk, v_blk, blk_causal: bool):
+        out, lse = flash_attention_with_lse(
+            q, k_blk, v_blk, causal=blk_causal,
+            block_q=block_q, block_k=block_k)
+        return out.astype(jnp.float32), lse
+
+    def step(carry, i):
+        (k_blk, v_blk), out_acc, lse_acc = carry
+        # Issue the rotation FIRST so the ICI transfer of the next K/V
+        # block overlaps this step's kernel (scan keeps the data
+        # dependency: the permuted block is only consumed next step).
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kv_next = jax.tree_util.tree_map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm),
+            (k_blk, v_blk))
+        src = (rank - i) % n      # whose block we currently hold
+
+        def merge(args):
+            out_blk, lse_blk = args
+            lse_new = jnp.logaddexp(lse_acc, lse_blk)
+            w1 = jnp.exp(lse_acc - lse_new)
+            w2 = jnp.exp(lse_blk - lse_new)
+            return (out_acc * w1[..., None] + out_blk * w2[..., None],
+                    lse_new)
+
+        def do_dense(_):
+            return merge(partial_flash(k_blk, v_blk, False))
+
+        def do_diag(_):
+            return merge(partial_flash(k_blk, v_blk, causal))
+
+        def do_skip(_):
+            return out_acc, lse_acc
+
+        if causal:
+            case = jnp.where(src == rank, 1,
+                             jnp.where(src < rank, 0, 2))
+            out_acc, lse_acc = jax.lax.switch(
+                case, [do_dense, do_diag, do_skip], None)
+        else:
+            out_acc, lse_acc = do_dense(None)
+        return (kv_next, out_acc, lse_acc), None
+
+    if checkpoint_steps:
+        step = jax.checkpoint(step)
+
+    out0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+    lse0 = jnp.full((b, t_local, h), _NEG_INF, jnp.float32)
+    (_, out, _), _ = jax.lax.scan(step, ((k, v), out0, lse0),
+                                  jnp.arange(n))
     return out.astype(q.dtype)
 
 

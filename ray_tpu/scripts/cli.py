@@ -371,6 +371,80 @@ def _cmd_job_inner(args) -> int:
     return 2
 
 
+def cmd_logs(args) -> int:
+    """Fetch worker/actor logs from node agents (ref:
+    dashboard/modules/log/ + `ray logs`); works for dead workers (the
+    log file outlives the process)."""
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    if args.job:
+        args.id = args.job
+        args.job_command = "logs"
+        return _cmd_job_inner(args)
+    nodes = [n for n in _call(address, "list_nodes") if n["alive"]]
+
+    def _nid_hex(n):
+        nid = n["node_id"]
+        return nid.hex() if hasattr(nid, "hex") else str(nid)
+
+    if args.node:
+        nodes = [n for n in nodes
+                 if _nid_hex(n).startswith(args.node)]
+    worker_sel = args.worker
+    pid_sel = args.pid
+    if args.actor:
+        actors = _call(address, "list_actors")
+        match = None
+        for a in actors:
+            aid = a["actor_id"]
+            aid = aid.hex() if hasattr(aid, "hex") else str(aid)
+            if a.get("name") == args.actor or aid.startswith(args.actor):
+                match = a
+                break
+        if match is None:
+            print(f"no actor matching {args.actor!r}", file=sys.stderr)
+            return 1
+        nid = match["node_id"]
+        nid = nid.hex() if hasattr(nid, "hex") else str(nid)
+        nodes = [n for n in nodes if _nid_hex(n) == nid]
+        # The agent resolves the worker by actor's worker address pid —
+        # list workers on that node and find the actor.
+        for n in nodes:
+            r = _call(n["agent_addr"], "list_workers")
+            aid_hex = (match["actor_id"].hex()
+                       if hasattr(match["actor_id"], "hex")
+                       else str(match["actor_id"]))
+            for w in r["workers"]:
+                if w.get("actor_id") == aid_hex:
+                    worker_sel = w["worker_id"]
+    if not worker_sel and pid_sel is None:
+        # Listing mode: show available logs.
+        for n in nodes:
+            r = _call(n["agent_addr"], "list_worker_logs")
+            for rec in r["logs"]:
+                print(f"{_nid_hex(n)[:12]} pid={rec['pid']:<8} "
+                      f"{rec['state']:<8} "
+                      f"worker={str(rec['worker_id'])[:12]} "
+                      f"{rec['size']}B")
+        return 0
+    for n in nodes:
+        req = {"max_bytes": args.tail * 200}
+        if worker_sel:
+            req["worker_id"] = worker_sel
+        if pid_sel is not None:
+            req["pid"] = pid_sel
+        r = _call(n["agent_addr"], "read_worker_log", req)
+        if r.get("ok"):
+            lines = r["text"].splitlines()
+            for line in lines[-args.tail:]:
+                print(line)
+            return 0
+    print("worker not found on any node", file=sys.stderr)
+    return 1
+
+
 def cmd_up(args) -> int:
     from ray_tpu.autoscaler import commands as _commands
 
@@ -484,6 +558,20 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address", default="")
     sp.add_argument("--port", type=int, default=8265)
     sp.set_defaults(fn=cmd_dashboard)
+
+    sp = sub.add_parser("logs",
+                        help="fetch worker/actor logs from node agents")
+    sp.add_argument("--worker", default="",
+                    help="worker id hex (prefix ok)")
+    sp.add_argument("--pid", type=int, default=None)
+    sp.add_argument("--actor", default="",
+                    help="actor name or id prefix")
+    sp.add_argument("--job", default="", help="job id (job logs)")
+    sp.add_argument("--node", default="", help="node id prefix filter")
+    sp.add_argument("--tail", type=int, default=200,
+                    help="lines from the end (default 200)")
+    sp.add_argument("--address", default="")
+    sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("up", help="launch a cluster from a YAML spec")
     sp.add_argument("spec", help="cluster YAML (see autoscaler/"

@@ -24,8 +24,9 @@ def _get_or_create_controller():
     except ValueError:
         cls = ray_tpu.remote(ServeController)
         # Control-plane actors are IO-bound: 0 CPUs, like the reference's
-        # serve controller/proxy actors.
-        return cls.options(name=CONTROLLER_NAME, max_concurrency=16,
+        # serve controller/proxy actors.  max_concurrency is sized for
+        # many handles/proxies parked in poll_update long-polls at once.
+        return cls.options(name=CONTROLLER_NAME, max_concurrency=64,
                            num_cpus=0, get_if_exists=True,
                            lifetime="detached").remote()
 
@@ -78,7 +79,8 @@ def run(app: Application, *, name: str = "default",
 
 
 def start_http_proxy(port: int = 0) -> int:
-    """Start (or reuse) the HTTP ingress; returns the bound port."""
+    """Start (or reuse) the HTTP ingress on THIS node; returns the
+    bound port."""
     global _http_proxy
     from .proxy import HTTPProxy
 
@@ -88,6 +90,31 @@ def start_http_proxy(port: int = 0) -> int:
                                   name="rt_serve_proxy",
                                   get_if_exists=True).remote(port)
     return ray_tpu.get(_http_proxy.port.remote())
+
+
+def start_http_proxies(port: int = 0) -> Dict[str, int]:
+    """One ingress proxy per alive node (ref: serve/_private/proxy.py
+    :763 — the reference runs an HTTPProxy on every ingress node so
+    losing a node's proxy leaves ingress up elsewhere).  Returns
+    {node_id_hex: port}."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    from .proxy import HTTPProxy
+
+    cls = ray_tpu.remote(HTTPProxy)
+    out: Dict[str, int] = {}
+    for n in ray_tpu.nodes():
+        if not n.get("Alive"):
+            continue
+        nid = n["NodeID"]
+        proxy = cls.options(
+            max_concurrency=32, num_cpus=0,
+            name=f"rt_serve_proxy_{nid[:12]}", get_if_exists=True,
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=nid, soft=False)).remote(port)
+        out[nid] = ray_tpu.get(proxy.port.remote())
+    return out
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:

@@ -105,11 +105,44 @@ class ServeController:
         # loop — redesigned lock+generation since our methods are
         # threaded).
         self._lock = threading.RLock()
+        # Config-push plumbing (ref: serve/_private/long_poll.py): one
+        # global version bumped on every replica-set/route change;
+        # handles and proxies long-poll poll_update() and get woken by
+        # the condition instead of re-polling on a timer.
+        self._version = 0
+        self._version_cond = threading.Condition(self._lock)
         self._loop_stop = threading.Event()
         self._loop_thread = threading.Thread(
             target=self._control_loop, daemon=True,
             name="serve-control-loop")
         self._loop_thread.start()
+
+    def _bump_version_locked(self) -> None:
+        self._version += 1
+        self._version_cond.notify_all()
+
+    def poll_update(self, name: Optional[str], known_version: int,
+                    timeout: float = 30.0) -> Dict[str, Any]:
+        """Long-poll: blocks until the serve config is newer than
+        ``known_version`` (or timeout), then returns the current
+        version, the named deployment's replicas, and the route table
+        (ref: long_poll.py LongPollHost.listen_for_change)."""
+        deadline = time.time() + timeout
+        with self._version_cond:
+            while self._version <= known_version:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._version_cond.wait(remaining)
+            entry = self.deployments.get(name) if name else None
+            return {
+                "version": self._version,
+                "changed": self._version > known_version,
+                "replicas": list(entry["replicas"]) if entry else [],
+                "routes": {e["route_prefix"]: n
+                           for n, e in self.deployments.items()
+                           if e["route_prefix"]},
+            }
 
     def deploy(self, name: str, cls_payload: bytes, init_args: tuple,
                init_kwargs: dict, num_replicas: int, is_function: bool,
@@ -280,6 +313,7 @@ class ServeController:
                 # control loop reaps once idle (30 s grace cap).
                 entry.setdefault("draining", []).append(
                     (victim, time.time(), victim.ongoing.remote()))
+            self._bump_version_locked()
             return len(entry["replicas"])
 
     def scale(self, name: str, num_replicas: int) -> int:
@@ -304,6 +338,7 @@ class ServeController:
                 max_concurrency=32, **entry.get("actor_options", {}))
             entry["replicas"][index] = replica_cls.remote(
                 entry["payload"], args, kwargs, entry["is_function"])
+            self._bump_version_locked()
             return True
 
     def get_replicas(self, name: str) -> List[Any]:
@@ -325,6 +360,7 @@ class ServeController:
     def delete(self, name: str) -> bool:
         with self._lock:
             entry = self.deployments.pop(name, None)
+            self._bump_version_locked()
         if entry:
             drained = [rec[0] for rec in entry.get("draining", [])]
             for r in entry["replicas"] + drained:
@@ -336,53 +372,126 @@ class ServeController:
 
 
 class DeploymentHandle:
-    """Client-side router with power-of-two-choices (ref:
-    pow_2_scheduler.py:52)."""
+    """Client-side router: power-of-two-choices over LOCALLY tracked
+    in-flight counts, with the replica set pushed by controller
+    long-poll (ref: pow_2_scheduler.py:52 cached-metrics routing +
+    long_poll.py config push).
+
+    The round-2 router cost two live RPCs per request (ongoing() probes
+    on two replicas); now dispatch is zero-RPC: the handle counts its
+    own in-flight requests per replica (incremented at dispatch,
+    decremented by the result future's done-callback) and a daemon
+    thread keeps the replica list fresh via poll_update().
+    """
 
     def __init__(self, deployment_name: str):
+        import threading
+
         self.deployment_name = deployment_name
         self._replicas: List[Any] = []
-        self._refresh_time = 0.0
+        self._version = -1
+        self._inflight: Dict[str, int] = {}   # actor_id hex -> count
+        self._lock = threading.Lock()
+        self._have_replicas = threading.Event()
+        self._poller: Optional[threading.Thread] = None
 
     def _controller(self):
         return ray_tpu.get_actor(CONTROLLER_NAME)
 
-    def _refresh(self, force: bool = False) -> None:
-        now = time.time()
-        if force or not self._replicas or now - self._refresh_time > 5.0:
-            self._replicas = ray_tpu.get(
-                self._controller().get_replicas.remote(
-                    self.deployment_name))
-            self._refresh_time = now
-        if not self._replicas:
+    # ------------------------------------------------------- config push
+    def _apply_update(self, r: Dict[str, Any]) -> None:
+        with self._lock:
+            self._version = r["version"]
+            self._replicas = list(r["replicas"])
+            live = {rep.actor_id.hex() for rep in self._replicas}
+            for key in list(self._inflight):
+                if key not in live:
+                    del self._inflight[key]
+        if self._replicas:
+            self._have_replicas.set()
+        else:
+            self._have_replicas.clear()
+
+    def _poll_loop(self) -> None:
+        while True:
+            try:
+                r = ray_tpu.get(self._controller().poll_update.remote(
+                    self.deployment_name, self._version, 25.0),
+                    timeout=40)
+                self._apply_update(r)
+            except Exception:
+                time.sleep(1.0)
+
+    def _ensure_fresh(self) -> None:
+        import threading
+
+        if self._poller is None or not self._poller.is_alive():
+            # Synchronous first fetch so the first request doesn't
+            # race the poller's startup.
+            try:
+                self._apply_update(ray_tpu.get(
+                    self._controller().poll_update.remote(
+                        self.deployment_name, -1, 0.0), timeout=30))
+            except Exception:
+                pass
+            self._poller = threading.Thread(
+                target=self._poll_loop, daemon=True,
+                name=f"serve-poll-{self.deployment_name}")
+            self._poller.start()
+        if not self._have_replicas.wait(timeout=30):
             raise RuntimeError(
                 f"deployment {self.deployment_name!r} has no replicas")
 
+    # ----------------------------------------------------------- routing
     def _pick(self):
-        self._refresh()
-        if len(self._replicas) == 1:
-            return self._replicas[0]
-        a, b = random.sample(self._replicas, 2)
+        """Two random candidates, lower LOCAL in-flight count wins —
+        no RPC on the dispatch path."""
+        self._ensure_fresh()
+        with self._lock:
+            if not self._replicas:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name!r} has no "
+                    "replicas")
+            if len(self._replicas) == 1:
+                chosen = self._replicas[0]
+            else:
+                a, b = random.sample(self._replicas, 2)
+                qa = self._inflight.get(a.actor_id.hex(), 0)
+                qb = self._inflight.get(b.actor_id.hex(), 0)
+                chosen = a if qa <= qb else b
+            key = chosen.actor_id.hex()
+            self._inflight[key] = self._inflight.get(key, 0) + 1
+        return chosen, key
+
+    def _track(self, ref, key: str):
+        def _done(_fut):
+            with self._lock:
+                n = self._inflight.get(key, 0) - 1
+                if n > 0:
+                    self._inflight[key] = n
+                else:
+                    self._inflight.pop(key, None)
+
         try:
-            qa, qb = ray_tpu.get([a.ongoing.remote(), b.ongoing.remote()],
-                                 timeout=2.0)
+            ref.future().add_done_callback(_done)
         except Exception:
-            self._refresh(force=True)
-            return random.choice(self._replicas)
-        return a if qa <= qb else b
+            _done(None)  # tracking failure must not leak the count
+        return ref
 
     def remote(self, *args, **kwargs):
-        replica = self._pick()
-        return replica.handle_request.remote(args, kwargs)
+        replica, key = self._pick()
+        return self._track(replica.handle_request.remote(args, kwargs),
+                           key)
 
     def method(self, method_name: str):
         handle = self
 
         class _M:
             def remote(self, *args, **kwargs):
-                replica = handle._pick()
-                return replica.call_method.remote(method_name, args,
-                                                  kwargs)
+                replica, key = handle._pick()
+                return handle._track(
+                    replica.call_method.remote(method_name, args,
+                                               kwargs), key)
 
         return _M()
 

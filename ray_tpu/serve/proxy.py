@@ -81,19 +81,48 @@ class HTTPProxy:
         self._ready.wait(30)
 
     def _route_table(self) -> Dict[str, str]:
-        import time
+        """Route table kept fresh by controller config PUSH: a daemon
+        thread parks in poll_update() and applies changes as they
+        happen (ref: long_poll.py — replaces the round-2 2 s TTL
+        poll)."""
+        if getattr(self, "_route_poller", None) is None or \
+                not self._route_poller.is_alive():
+            self._route_cache: Dict[str, str] = {}
+            self._route_version = -1
+            self._start_route_poller()
+        return self._route_cache
 
+    def _start_route_poller(self) -> None:
         import ray_tpu
+        from .controller import CONTROLLER_NAME
 
-        now = time.time()
-        cached = getattr(self, "_route_cache", None)
-        if cached is None or now - cached[1] > 2.0:
-            from .controller import CONTROLLER_NAME
-
+        # Synchronous first fetch so the first request routes.
+        try:
             ctl = ray_tpu.get_actor(CONTROLLER_NAME)
-            table = ray_tpu.get(ctl.routes.remote())
-            self._route_cache = (table, now)
-        return self._route_cache[0]
+            r = ray_tpu.get(ctl.poll_update.remote(None, -1, 0.0),
+                            timeout=30)
+            self._route_cache = r["routes"]
+            self._route_version = r["version"]
+        except Exception:
+            pass
+
+        def loop():
+            import time as _t
+
+            import ray_tpu
+            while True:
+                try:
+                    ctl = ray_tpu.get_actor(CONTROLLER_NAME)
+                    r = ray_tpu.get(ctl.poll_update.remote(
+                        None, self._route_version, 25.0), timeout=40)
+                    self._route_cache = r["routes"]
+                    self._route_version = r["version"]
+                except Exception:
+                    _t.sleep(1.0)
+
+        self._route_poller = threading.Thread(
+            target=loop, daemon=True, name="serve-route-poll")
+        self._route_poller.start()
 
     def port(self) -> int:
         self._ready.wait(30)

@@ -141,3 +141,94 @@ def summarize_tasks(*, address: Optional[str] = None) -> Dict[str, int]:
         s = rec.get("state", "?")
         counts[s] = counts.get(s, 0) + 1
     return counts
+
+
+# ---------------------------------------------------------------- log plane
+def _agent_call(agent_addr: str, method: str,
+                payload: Optional[Dict] = None) -> Any:
+    from ..core.rpc import RpcClient
+
+    async def _go():
+        cli = RpcClient(agent_addr, connect_timeout=10.0)
+        try:
+            return await cli.call(method, payload or {})
+        finally:
+            await cli.close()
+
+    return asyncio.run(_go())
+
+
+def _agents(node_id: Optional[str], address: Optional[str]) -> List[Dict]:
+    nodes = [n for n in list_nodes(address=address) if n["alive"]]
+    if node_id:
+        nodes = [n for n in nodes
+                 if str(n["node_id"]).startswith(node_id)]
+    return nodes
+
+
+def list_logs(*, node_id: Optional[str] = None,
+              address: Optional[str] = None) -> List[Dict]:
+    """Per-worker log-file inventory across nodes (ref:
+    dashboard/modules/log/ listing)."""
+    out = []
+    for n in _agents(node_id, address):
+        r = _agent_call(n["agent_addr"], "list_worker_logs")
+        for rec in r["logs"]:
+            out.append({"node_id": n["node_id"], **rec})
+    return out
+
+
+def get_log(*, worker_id: Optional[str] = None,
+            pid: Optional[int] = None,
+            node_id: Optional[str] = None,
+            max_bytes: int = 256 * 1024,
+            address: Optional[str] = None) -> str:
+    """Fetch a worker's stdout/stderr tail — dead workers included
+    (ref: `ray logs`, dashboard/modules/log/)."""
+    req: Dict[str, Any] = {"max_bytes": max_bytes}
+    if worker_id:
+        req["worker_id"] = worker_id
+    if pid is not None:
+        req["pid"] = pid
+    for n in _agents(node_id, address):
+        r = _agent_call(n["agent_addr"], "read_worker_log", req)
+        if r.get("ok"):
+            return r["text"]
+    raise ValueError("worker log not found on any alive node")
+
+
+def profile_worker(*, worker_id: Optional[str] = None,
+                   pid: Optional[int] = None,
+                   node_id: Optional[str] = None,
+                   duration_s: float = 2.0, hz: float = 100.0,
+                   address: Optional[str] = None) -> Dict[str, int]:
+    """Sampling-profile a live worker; returns folded stacks (ref:
+    profile_manager.py:121 — see util/profiling.py for the in-process
+    redesign)."""
+    req: Dict[str, Any] = {"duration_s": duration_s, "hz": hz}
+    if worker_id:
+        req["worker_id"] = worker_id
+    if pid is not None:
+        req["pid"] = pid
+    for n in _agents(node_id, address):
+        r = _agent_call(n["agent_addr"], "profile_worker", req)
+        if r.get("ok"):
+            return r["folded"]
+    raise ValueError("worker not found on any alive node")
+
+
+def stack_worker(*, worker_id: Optional[str] = None,
+                 pid: Optional[int] = None,
+                 node_id: Optional[str] = None,
+                 address: Optional[str] = None) -> str:
+    """All-thread stack dump of a live worker (py-spy --dump role)."""
+    req: Dict[str, Any] = {}
+    if worker_id:
+        req["worker_id"] = worker_id
+    if pid is not None:
+        req["pid"] = pid
+    for n in _agents(node_id, address):
+        r = _agent_call(n["agent_addr"], "stack_worker", req)
+        if r.get("ok"):
+            return r["stacks"]
+    raise ValueError("worker not found on any alive node")

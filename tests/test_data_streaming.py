@@ -156,3 +156,63 @@ def test_tensor_block_arrow_roundtrip(small_store_rt, tmp_path):
     np.testing.assert_allclose(first, [0, 1, 2])
     df = ds.iter_batches(batch_size=4, batch_format="pandas")
     assert len(next(iter(df))) == 4
+
+
+# ------------------------------------------------- distributed barriers
+def _indexed_dataset(n_blocks, rows_per_block, payload_cols=0):
+    def make_source(i):
+        def src():
+            from ray_tpu.data.block import build_block
+
+            rows = []
+            for j in range(rows_per_block):
+                row = {"i": i * rows_per_block + j}
+                if payload_cols:
+                    row["payload"] = np.full(payload_cols, 1.0,
+                                             np.float32)
+                rows.append(row)
+            return build_block(rows)
+        return src
+
+    return rt_data.Dataset([make_source(i) for i in range(n_blocks)])
+
+
+def test_random_shuffle_is_distributed_and_correct(small_store_rt):
+    n = 8 * 200
+    ds = _indexed_dataset(8, 200)
+    out = ds.random_shuffle(seed=7)
+    # Result datasets are ref-backed: nothing materialized on driver.
+    assert out._materialized is None
+    ids = [r["i"] for r in out.iter_rows()]
+    assert sorted(ids) == list(range(n))       # same multiset
+    assert ids != list(range(n))               # actually shuffled
+    # Deterministic under the same seed.
+    ids2 = [r["i"] for r in ds.random_shuffle(seed=7).iter_rows()]
+    assert ids2 == ids
+    ids3 = [r["i"] for r in ds.random_shuffle(seed=8).iter_rows()]
+    assert ids3 != ids
+
+
+def test_repartition_preserves_rows_without_driver(small_store_rt):
+    ds = _indexed_dataset(3, 100)
+    out = ds.repartition(5)
+    assert out._materialized is None
+    assert out.num_blocks() == 5
+    ids = sorted(r["i"] for r in out.iter_rows())
+    assert ids == list(range(300))
+
+
+def test_uneven_split_remote(small_store_rt):
+    # 3 blocks into 2 shards: not evenly divisible by sources -> the
+    # row-granularity path, now remote tasks instead of take_all().
+    ds = _indexed_dataset(3, 100)
+    shards = ds.split(2, equal=True)
+    assert len(shards) == 2
+    counts = [sum(1 for _ in s.iter_rows()) for s in shards]
+    assert counts == [150, 150]
+    all_ids = sorted(r["i"] for s in shards for r in s.iter_rows())
+    assert all_ids == list(range(300))  # equal split covers all rows
+
+    shards = ds.split(2, equal=False)
+    counts = [sum(1 for _ in s.iter_rows()) for s in shards]
+    assert sorted(counts) == [150, 150]

@@ -1,0 +1,143 @@
+"""Log + profiling plane (round-2 VERDICT item 4).
+
+- a print() in a remote task reaches the driver's console, job-tagged
+  (ref: _private/log_monitor.py:103 driver streaming);
+- `rt logs` / the state API fetch a DEAD worker's output (the file
+  outlives the process — ref: dashboard/modules/log/);
+- a live worker can be stack-dumped and sampling-profiled, and the
+  folded stacks render to an SVG flamegraph (ref:
+  dashboard/modules/reporter/profile_manager.py:121,189).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state as state_api
+
+
+@pytest.fixture(scope="module")
+def rt():
+    r = ray_tpu.init(mode="cluster", num_cpus=2)
+    yield r
+    ray_tpu.shutdown()
+
+
+def test_remote_print_streams_to_driver(rt, capfd):
+    @ray_tpu.remote
+    def chatty():
+        print("hello-from-worker-TASK77")
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+    # The agent tails every 0.5s and the driver long-polls; give the
+    # pipeline a moment.
+    deadline = time.time() + 20
+    seen = ""
+    while time.time() < deadline:
+        seen += capfd.readouterr().out
+        if "hello-from-worker-TASK77" in seen:
+            break
+        time.sleep(0.3)
+    assert "hello-from-worker-TASK77" in seen
+    # Job-tagged prefix: "(pid, node=...)".
+    line = [ln for ln in seen.splitlines()
+            if "hello-from-worker-TASK77" in ln][0]
+    assert "node=" in line
+
+
+def test_fetch_dead_worker_log(rt):
+    @ray_tpu.remote
+    def doomed():
+        import os
+
+        print("last-words-XYZZY", flush=True)
+        return os.getpid()
+
+    pid = ray_tpu.get(doomed.remote(), timeout=60)
+    # Find and SIGKILL that worker, then fetch its log post-mortem.
+    import os
+    import signal
+
+    time.sleep(1.0)  # let the tailer checkpoint + log flush
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    time.sleep(1.0)
+    text = state_api.get_log(pid=pid)
+    assert "last-words-XYZZY" in text
+
+
+def test_log_listing(rt):
+    logs = state_api.list_logs()
+    assert logs, "no worker logs listed"
+    assert all("pid" in rec and "path" in rec for rec in logs)
+
+
+def test_stack_and_profile_live_worker(rt):
+    @ray_tpu.remote
+    class Spinner:
+        def spin(self, seconds):
+            import time as _t
+
+            end = _t.time() + seconds
+
+            def inner_loop():
+                x = 0
+                while _t.time() < end:
+                    x += sum(range(100))
+                return x
+
+            return inner_loop()
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    s = Spinner.remote()
+    pid = ray_tpu.get(s.pid.remote(), timeout=60)
+    ref = s.spin.remote(6.0)  # busy while we profile
+
+    stacks = state_api.stack_worker(pid=pid)
+    assert "thread" in stacks.lower()
+
+    folded = state_api.profile_worker(pid=pid, duration_s=1.5, hz=50)
+    assert folded, "no samples collected"
+    assert any("inner_loop" in stack for stack in folded)
+
+    from ray_tpu.util.profiling import render_flamegraph_svg
+
+    svg = render_flamegraph_svg(folded, title="spin")
+    assert svg.startswith("<svg") and "inner_loop" in svg
+    ray_tpu.get(ref, timeout=60)
+
+
+def test_rt_logs_cli(rt):
+    """`rt logs` lists logs and tails a worker by pid."""
+    import io
+    import contextlib
+
+    from ray_tpu.scripts.cli import main as cli_main
+
+    @ray_tpu.remote
+    def mark():
+        import os
+
+        print("cli-tail-MARKER-42", flush=True)
+        return os.getpid()
+
+    pid = ray_tpu.get(mark.remote(), timeout=60)
+    time.sleep(0.5)
+    addr = rt.controller_addr
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["logs", "--address", addr])
+    assert rc == 0 and str(pid) in buf.getvalue()
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["logs", "--pid", str(pid), "--address", addr])
+    assert rc == 0
+    assert "cli-tail-MARKER-42" in buf.getvalue()

@@ -49,8 +49,9 @@ def test_sharding_rules_prune():
     assert sh2.spec == P(("data",), None)
 
 
+@pytest.mark.parametrize("impl", ["flash", "lax"])
 @pytest.mark.parametrize("causal", [True, False])
-def test_ring_attention_matches_dense(causal):
+def test_ring_attention_matches_dense(causal, impl):
     mesh = create_mesh(MeshSpec(seq=4, data=2))
     b, t, h, d = 2, 32, 4, 16
     key = jax.random.PRNGKey(0)
@@ -58,7 +59,7 @@ def test_ring_attention_matches_dense(causal):
 
     spec = P(("data",), "seq", None, None)
     ring = shard_map(
-        functools.partial(ring_attention, causal=causal),
+        functools.partial(ring_attention, causal=causal, impl=impl),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     out = jax.jit(ring)(q, k, v)
@@ -67,13 +68,15 @@ def test_ring_attention_matches_dense(causal):
                                atol=2e-5, rtol=2e-5)
 
 
-def test_ring_attention_gradients():
+@pytest.mark.parametrize("impl", ["flash", "lax"])
+def test_ring_attention_gradients(impl):
     mesh = create_mesh(MeshSpec(seq=4, data=-1))
     b, t, h, d = 1, 16, 2, 8
     q, k, v = jax.random.normal(jax.random.PRNGKey(1), (3, b, t, h, d))
 
     spec = P(None, "seq", None, None)
-    ring = shard_map(functools.partial(ring_attention, causal=True),
+    ring = shard_map(functools.partial(ring_attention, causal=True,
+                                       impl=impl),
                      mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)
 

@@ -197,3 +197,60 @@ def test_request_autoscaling_up_and_down():
         time.sleep(0.5)
     else:
         raise TimeoutError("never scaled back down")
+
+
+def test_config_push_fast_and_zero_rpc_router():
+    """Round-2 VERDICT item 5: config changes reach handles via
+    long-poll push (not a 5 s poll), and dispatch does no live RPCs —
+    in-flight counts are tracked locally via result futures."""
+    @serve.deployment(num_replicas=1, name="pushy")
+    def echo(x):
+        return x
+
+    handle = serve.run(echo.bind())
+    assert ray_tpu.get(handle.remote(7), timeout=60) == 7
+    v0_replicas = list(handle._replicas)
+    assert len(v0_replicas) == 1
+
+    # Scale up: the pushed update must land well under a poll cycle.
+    serve.scale("pushy", 3)
+    deadline = time.time() + 3.0  # push target ~100ms; CI slack
+    while time.time() < deadline:
+        if len(handle._replicas) == 3:
+            break
+        time.sleep(0.05)
+    assert len(handle._replicas) == 3, "push update never arrived"
+
+    # Local in-flight accounting: dispatch increments, completion
+    # decrements — no ongoing() probe RPCs on the path.
+    refs = [handle.remote(i) for i in range(6)]
+    assert sum(handle._inflight.values()) > 0
+    assert ray_tpu.get(refs, timeout=60) == list(range(6))
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if sum(handle._inflight.values()) == 0:
+            break
+        time.sleep(0.1)
+    assert sum(handle._inflight.values()) == 0, handle._inflight
+    serve.delete("pushy")
+
+
+def test_proxy_per_node(tmp_path):
+    """start_http_proxies puts one ingress on every alive node; each
+    serves the same routes."""
+    @serve.deployment(num_replicas=1, name="multi_ingress")
+    def hello(x):
+        return {"got": x}
+
+    serve.run(hello.bind(), route_prefix="/hello")
+    ports = serve.start_http_proxies()
+    assert len(ports) >= 1
+    for nid, port in ports.items():
+        body = json.dumps({"x": 1}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/hello", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        assert out["result"]["got"] == {"x": 1}
+    serve.delete("multi_ingress")

@@ -124,3 +124,42 @@ def test_pbt_population_converges(rt):
         # restart continues climbing rather than restarting at ~rate.
         post = [r["score"] for r in t.history]
         assert post[-1] > 5, (t.config, post)
+
+
+def test_elastic_policy_sizes_by_tpu_not_cpu():
+    """Round-2 VERDICT item 7: TPU (custom-resource) capacity, not
+    CPU, must be the binding constraint for a TPU gang resize; slice
+    atomicity snaps to whole slices."""
+    cluster = None
+    try:
+        # Plenty of CPU (8), few chips (6 TPUs over two hosts).
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 4,
+                                          "num_tpus": 4})
+        cluster.add_node(num_cpus=4, num_tpus=2)
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes()
+
+        pol = rt_train.ElasticScalingPolicy(
+            min_workers=1, max_workers=8,
+            resources_per_worker={"TPU": 2.0, "CPU": 1.0})
+        # 6 chips / 2 per worker = 3 workers — NOT 8 (CPU would fit 8).
+        assert pol.workers_for_attempt(0) == 3
+
+        # Slice atomicity: 2 hosts per slice -> snap 3 down to 2.
+        pol_slice = rt_train.ElasticScalingPolicy(
+            min_workers=1, max_workers=8,
+            resources_per_worker={"TPU": 2.0},
+            workers_per_slice=2)
+        assert pol_slice.workers_for_attempt(0) == 2
+
+        # from_scaling_config derives the shape from the trainer cfg.
+        cfg = rt_train.ScalingConfig(
+            num_workers=8, resources_per_worker={"TPU": 2.0})
+        pol2 = rt_train.ElasticScalingPolicy.from_scaling_config(cfg)
+        assert pol2.resources_per_worker == {"TPU": 2.0}
+        assert pol2.workers_for_attempt(0) == 3
+    finally:
+        ray_tpu.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
