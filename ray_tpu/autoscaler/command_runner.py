@@ -93,6 +93,11 @@ class SubprocessCommandRunner(CommandRunner):
         return proc.stdout
 
     def put(self, local_path: str, remote_path: str) -> None:
+        # "Remote" is this host: the head may share session paths with
+        # the launcher (e.g. the cluster state file), so copying a file
+        # onto itself must be a no-op, not a SameFileError.
+        if os.path.abspath(local_path) == os.path.abspath(remote_path):
+            return
         os.makedirs(os.path.dirname(remote_path) or "/", exist_ok=True)
         if os.path.isdir(local_path):
             shutil.copytree(local_path, remote_path,
@@ -146,7 +151,17 @@ class SSHCommandRunner(CommandRunner):
 
     def put(self, local_path: str, remote_path: str) -> None:
         # rsync if available (delta sync, like the reference's
-        # rsync_up); scp -r otherwise.
+        # rsync_up); scp -r otherwise.  Neither creates missing parent
+        # directories on the target, so make them first.  A leading ~/
+        # must stay OUTSIDE the quotes or the remote shell won't expand
+        # it (and mkdir would create a literal '~' directory).
+        parent = os.path.dirname(remote_path.rstrip("/"))
+        if parent and parent not in ("/", "~"):
+            if parent.startswith("~/"):
+                quoted = "~/" + shlex.quote(parent[2:])
+            else:
+                quoted = shlex.quote(parent)
+            self.run(f"mkdir -p {quoted}", timeout=60.0)
         if shutil.which("rsync"):
             ssh_cmd = " ".join(self._ssh_base())
             src = local_path + ("/" if os.path.isdir(local_path)
@@ -206,14 +221,30 @@ class PodCommandRunner(CommandRunner):
             futs = [pool.submit(_one, i)
                     for i in range(len(self.runners))]
             outs, errors = [], []
-            for f in futs:
+            for i, f in enumerate(futs):
                 try:
                     outs.append(f.result())
                 except Exception as e:  # noqa: BLE001 — aggregate
-                    errors.append(e)
+                    errors.append((self.runners[i].host, e))
                     outs.append("")
             if errors:
-                raise errors[0]
+                if len(errors) == 1:
+                    raise errors[0][1]
+                # CommandRunnerError keeps only the last 2000 message
+                # chars, so bound each host's contribution INCLUDING
+                # its '--- host: Type: ' prefix — every failing host
+                # must stay visible in the rendered error.
+                per_host = max(64, 1900 // len(errors) - 80)
+                detail = "\n".join(
+                    f"--- {host}: {type(e).__name__}: "
+                    + str(e)[-per_host:]
+                    for host, e in errors)
+                agg = CommandRunnerError(
+                    self.host, cmd, -1,
+                    f"{len(errors)}/{len(self.runners)} hosts failed:\n"
+                    + detail)
+                agg.errors = [e for _, e in errors]
+                raise agg
             return outs
 
     def put(self, local_path: str, remote_path: str) -> None:

@@ -152,12 +152,29 @@ class RemoteNodeProvider(NodeProvider):
                 from concurrent.futures import ThreadPoolExecutor
 
                 with ThreadPoolExecutor(len(unit)) as pool:
-                    outs = list(pool.map(_boot, range(len(unit))))
+                    futs = [pool.submit(_boot, i)
+                            for i in range(len(unit))]
+                    outs: List[Optional[Dict[str, str]]] = []
+                    first_err: Optional[BaseException] = None
+                    for f in futs:
+                        try:
+                            outs.append(f.result())
+                        except Exception as e:  # noqa: BLE001
+                            first_err = first_err or e
+                            outs.append(None)
                 for host, tr in zip(unit, outs):
+                    if tr is None:
+                        continue
                     node.node_ids.append(tr.get("RT_NODE_ID", ""))
                     node.pids_by_host[host] = [
                         int(x) for x in
                         tr.get("RT_PIDS", "").split(",") if x]
+                if first_err is not None:
+                    # A sibling host failed: agents already started on
+                    # the hosts that succeeded would be orphaned when
+                    # the unit returns to the free pool — kill them.
+                    self._kill_node_pids(node)
+                    raise first_err
             else:
                 runner = make_runner(self.spec, unit)
                 tr = self._bootstrap_host(runner,
@@ -176,11 +193,8 @@ class RemoteNodeProvider(NodeProvider):
         logger.info("launched %s on %s", pid, unit)
         return pid
 
-    def terminate_node(self, provider_id: str) -> None:
-        with self._lock:
-            node = self._nodes.pop(provider_id, None)
-        if node is None:
-            return
+    def _kill_node_pids(self, node: "_LaunchedNode") -> None:
+        """Best-effort kill of every agent pid recorded for ``node``."""
         hosts = node.unit if isinstance(node.unit, list) else [node.unit]
         for host in hosts:
             pids = node.pids_by_host.get(host, [])
@@ -193,8 +207,15 @@ class RemoteNodeProvider(NodeProvider):
                            f"kill -9 {kill} 2>/dev/null; true",
                            timeout=60.0, check=False)
             except Exception:
-                logger.warning("terminate %s: kill on %s failed",
-                               provider_id, host, exc_info=True)
+                logger.warning("kill on %s failed for %s",
+                               host, node.provider_id, exc_info=True)
+
+    def terminate_node(self, provider_id: str) -> None:
+        with self._lock:
+            node = self._nodes.pop(provider_id, None)
+        if node is None:
+            return
+        self._kill_node_pids(node)
         with self._lock:
             self._free.setdefault(node.node_type, []).append(node.unit)
 

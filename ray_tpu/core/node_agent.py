@@ -1298,6 +1298,9 @@ class NodeAgent:
         # fully-drained dead entries are dropped so the tail set stays
         # bounded under worker churn.
         idle_dead: Dict[str, int] = {}
+        # Dead workers' paths already fully drained: never re-tailed
+        # (but still resolvable via _worker_log_paths for fetch).
+        drained: set = set()
         while True:
             await asyncio.sleep(0.5)
             batch = []
@@ -1310,7 +1313,8 @@ class NodeAgent:
                                         w.job_id)
             for pid, path in getattr(self, "_worker_log_paths",
                                      {}).items():
-                meta.setdefault(path, (pid, None, None))
+                if path not in drained:
+                    meta.setdefault(path, (pid, None, None))
             for path, (pid, wid, job) in list(meta.items()):
                 try:
                     with open(path, "rb") as f:
@@ -1324,12 +1328,30 @@ class NodeAgent:
                     if pid not in live_pids:
                         idle_dead[path] = idle_dead.get(path, 0) + 1
                         if idle_dead[path] >= 6:  # ~3s fully drained
+                            # Drop from the TAILING set only; the
+                            # pid→path mapping stays (it's tiny) so
+                            # read_worker_log/list_worker_logs keep
+                            # serving dead workers — the file outlives
+                            # the process.
                             meta.pop(path, None)
                             offsets.pop(path, None)
                             idle_dead.pop(path, None)
-                            getattr(self, "_worker_log_paths",
-                                    {}).pop(pid, None)
+                            drained.add(path)
+                            # Bound retained dead entries under churn:
+                            # keep the most recent 256 (insertion order
+                            # of _worker_log_paths = spawn order).
+                            wlp = getattr(self, "_worker_log_paths",
+                                          {})
+                            if len(drained) > 256:
+                                for dpid, dpath in list(wlp.items()):
+                                    if len(drained) <= 256:
+                                        break
+                                    if (dpath in drained
+                                            and dpid not in live_pids):
+                                        wlp.pop(dpid, None)
+                                        drained.discard(dpath)
                     continue
+                drained.discard(path)
                 idle_dead.pop(path, None)
                 lines = data[:nl].decode("utf-8",
                                          "replace").splitlines()

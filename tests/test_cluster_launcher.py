@@ -293,3 +293,34 @@ def test_head_autoscaler_adopts_up_launched_workers(launcher_spec):
     r = asyncio.new_event_loop().run_until_complete(_one_pass())
     assert r["launched"] == [], \
         f"adopted min_worker was double-launched: {r}"
+
+
+def test_rt_up_with_head_autoscaler(launcher_spec):
+    """`rt up` WITHOUT --no-autoscaler: shipping the cluster state to
+    the head must tolerate source==destination (subprocess provider
+    shares the session dir — round-3 advisor SameFileError), and the
+    background autoscaler process must come up."""
+    log = (f"/tmp/rt_autoscaler_"
+           f"{load_cluster_spec(launcher_spec).cluster_name}.log")
+    if os.path.exists(log):  # run_background appends; drop stale runs
+        os.unlink(log)
+    state = rt_commands.up(launcher_spec)
+    address = state["address"]
+    # Head + min worker register; the head-side autoscaler adopted the
+    # launched worker instead of double-launching onto its host.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if len(_alive_nodes(address)) >= 2:
+            break
+        time.sleep(0.5)
+    assert len(_alive_nodes(address)) == 2
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if os.path.exists(log) and os.path.getsize(log) > 0:
+            break
+        time.sleep(0.5)
+    assert os.path.exists(log), "autoscaler never started on the head"
+    time.sleep(2.0)
+    assert len(_alive_nodes(address)) == 2, \
+        "head autoscaler double-launched an adopted worker"
+    rt_commands.down(launcher_spec)

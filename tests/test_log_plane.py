@@ -68,6 +68,13 @@ def test_fetch_dead_worker_log(rt):
     time.sleep(1.0)
     text = state_api.get_log(pid=pid)
     assert "last-words-XYZZY" in text
+    # Logs must stay fetchable AFTER the tailer drains and drops the
+    # dead worker from its tailing set (~3s) — the pid→path mapping
+    # outlives the drain (round-3 advisor finding).
+    time.sleep(4.0)
+    text = state_api.get_log(pid=pid)
+    assert "last-words-XYZZY" in text
+    assert any(rec["pid"] == pid for rec in state_api.list_logs())
 
 
 def test_log_listing(rt):
