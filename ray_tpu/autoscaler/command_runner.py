@@ -231,18 +231,26 @@ class PodCommandRunner(CommandRunner):
                 if len(errors) == 1:
                     raise errors[0][1]
                 # CommandRunnerError keeps only the last 2000 message
-                # chars, so bound each host's contribution INCLUDING
-                # its '--- host: Type: ' prefix — every failing host
-                # must stay visible in the rendered error.
-                per_host = max(64, 1900 // len(errors) - 80)
-                detail = "\n".join(
-                    f"--- {host}: {type(e).__name__}: "
-                    + str(e)[-per_host:]
-                    for host, e in errors)
+                # chars.  Show as many hosts as fit (each line budgeted
+                # including its '--- host: Type: ' prefix); past ~12
+                # failing hosts, elide the middle EXPLICITLY rather than
+                # letting truncation silently cut the earliest ones.
+                # The full exception list rides on agg.errors.
+                shown = errors
+                elided = 0
+                if len(errors) > 12:
+                    shown = errors[:6] + errors[-6:]
+                    elided = len(errors) - 12
+                per_host = max(64, 1800 // len(shown) - 80)
+                lines = [f"--- {host}: {type(e).__name__}: "
+                         + str(e)[-per_host:] for host, e in shown]
+                if elided:
+                    lines.insert(6, f"--- ... {elided} more failing "
+                                    f"hosts elided (see .errors) ...")
                 agg = CommandRunnerError(
                     self.host, cmd, -1,
                     f"{len(errors)}/{len(self.runners)} hosts failed:\n"
-                    + detail)
+                    + "\n".join(lines))
                 agg.errors = [e for _, e in errors]
                 raise agg
             return outs

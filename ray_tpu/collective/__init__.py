@@ -1,0 +1,259 @@
+"""Public collective API — ``ray_tpu.collective``.
+
+Role-equivalent to the reference's ray.util.collective surface (ref:
+python/ray/util/collective/collective.py:40 GroupManager, :120
+init_collective_group, :151 declarative create_collective_group via a
+named Info store, :258 allreduce and friends), redesigned for TPU:
+
+- ``backend="xla"`` (the NCCL replacement) bootstraps jax.distributed
+  across the member processes and exposes BOTH eager host collectives
+  and ``get_group(...).global_mesh()`` — the in-graph path where
+  collectives are mesh axes (psum/all_gather inside jit) riding ICI.
+- ``backend="cpu"`` (the GLOO replacement) is a host TCP group for
+  control-plane tensors.
+
+Rendezvous rides the controller KV instead of a detached named actor:
+members publish/poll ``col/<group>/...`` keys.  Deviation from the
+reference: collectives here are FUNCTIONAL — they return the result
+array rather than mutating the input in place (jax arrays are
+immutable; in-place mutation is a torch idiom).
+
+Consumers: IMPALA learner weight sync (ray_tpu.rl.impala) and the Train
+JaxBackend gang bootstrap (ray_tpu.train.backend).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence
+
+from .types import Backend, GroupInfo, ReduceOp
+
+__all__ = [
+    "Backend", "ReduceOp", "GroupInfo", "GroupManager",
+    "init_collective_group", "create_collective_group",
+    "is_group_initialized", "destroy_collective_group", "get_group",
+    "get_rank", "get_collective_group_size", "allreduce", "allgather",
+    "reducescatter", "broadcast", "barrier", "send", "recv",
+]
+
+logger = logging.getLogger("ray_tpu.collective")
+
+_DECL_PREFIX = "col/decl/"          # declarative group info in the KV
+
+
+class KVStore:
+    """Rendezvous store over the controller KV (the named-Info-actor
+    pattern, ref: collective.py:151, replayed onto the GCS-equivalent).
+
+    Backends call set(key, str)/get(key) -> str|None; keys are
+    namespaced ``col/<group>/...`` by the callers."""
+
+    def __init__(self):
+        from ray_tpu.core import runtime as _rt
+
+        self._rt = _rt.get_runtime()
+        if not hasattr(self._rt, "controller_call"):
+            raise RuntimeError(
+                "collective groups need the cluster runtime "
+                "(init(mode='cluster') or a connected worker); "
+                "local mode has no controller KV")
+
+    def set(self, key: str, value: str) -> None:
+        self._rt.controller_call(
+            "kv_put", {"key": key, "value": value.encode()})
+
+    def get(self, key: str) -> Optional[str]:
+        raw = self._rt.controller_call("kv_get", {"key": key})
+        return raw.decode() if raw is not None else None
+
+    def delete(self, key: str) -> None:
+        self._rt.controller_call("kv_del", {"key": key})
+
+
+class GroupManager:
+    """Per-process registry of collective-group memberships (ref:
+    collective.py:40 — one instance per process, a process may belong
+    to many groups)."""
+
+    def __init__(self):
+        self._groups: Dict[str, Any] = {}
+        self._infos: Dict[str, GroupInfo] = {}
+
+    def create_collective_group(self, backend, world_size: int,
+                                rank: int, group_name: str):
+        backend = Backend.parse(backend)
+        store = KVStore()
+        if backend == Backend.CPU:
+            from .collective_group.cpu_group import CPUGroup
+
+            g = CPUGroup(group_name, world_size, rank, store)
+        else:
+            from .collective_group.xla_group import XLAGroup
+
+            g = XLAGroup(group_name, world_size, rank, store)
+        self._groups[group_name] = g
+        self._infos[group_name] = GroupInfo(group_name, world_size,
+                                            rank, backend)
+        return g
+
+    def is_group_exist(self, group_name: str) -> bool:
+        return group_name in self._groups
+
+    def get_group_by_name(self, group_name: str):
+        return self._groups.get(group_name)
+
+    def destroy_collective_group(self, group_name: str) -> None:
+        g = self._groups.pop(group_name, None)
+        self._infos.pop(group_name, None)
+        if g is not None:
+            g.destroy()
+
+
+_group_mgr = GroupManager()
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    """True if THIS process already joined ``group_name``."""
+    return _group_mgr.is_group_exist(group_name)
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "cpu",
+                          group_name: str = "default"):
+    """Join a collective group from inside a worker/actor process (ref:
+    collective.py:120).  Blocks until all ``world_size`` members have
+    rendezvoused.  Returns the group handle."""
+    if not group_name:
+        raise ValueError("group_name must be a non-empty string")
+    if not (0 <= rank < world_size):
+        raise ValueError(f"rank {rank} outside [0, {world_size})")
+    if _group_mgr.is_group_exist(group_name):
+        raise RuntimeError(
+            f"group {group_name!r} already initialized in this process")
+    return _group_mgr.create_collective_group(backend, world_size, rank,
+                                              group_name)
+
+
+def create_collective_group(actors: Sequence[Any], world_size: int,
+                            ranks: Sequence[int],
+                            backend: str = "cpu",
+                            group_name: str = "default") -> None:
+    """Declare a list of actors as a collective group, from the DRIVER
+    (ref: collective.py:146).  Membership info is stored in the
+    controller KV; each actor lazily joins on its first collective call
+    (looked up by its own actor id)."""
+    backend = Backend.parse(backend)
+    if len(ranks) != len(actors) or world_size != len(actors):
+        raise ValueError(
+            f"need one rank per actor and world_size == len(actors); "
+            f"got {len(actors)} actors, {len(ranks)} ranks, "
+            f"world_size={world_size}")
+    if sorted(ranks) != list(range(world_size)):
+        raise ValueError(
+            f"ranks must be a permutation of 0..{world_size - 1}, "
+            f"got {list(ranks)}")
+    import json
+
+    store = KVStore()
+    key = _DECL_PREFIX + group_name
+    if store.get(key) is not None:
+        raise RuntimeError(f"group {group_name!r} already declared")
+    info = {"backend": backend.value, "world_size": world_size,
+            "ranks": {a.actor_id.hex(): int(r)
+                      for a, r in zip(actors, ranks)}}
+    store.set(key, json.dumps(info))
+
+
+def _lazy_join(group_name: str):
+    """Inside an actor: join a driver-declared group by looking up this
+    actor's rank in the KV declaration (ref: collective.py
+    _check_and_get_group's lazy init through the Info actor)."""
+    import json
+
+    import ray_tpu
+
+    store = KVStore()
+    raw = store.get(_DECL_PREFIX + group_name)
+    if raw is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in "
+            f"this process and was never declared via "
+            f"create_collective_group()")
+    info = json.loads(raw)
+    my_id = ray_tpu.get_runtime_context().get_actor_id()
+    if my_id is None or my_id not in info["ranks"]:
+        raise RuntimeError(
+            f"this process (actor {my_id}) is not a member of "
+            f"collective group {group_name!r}")
+    return _group_mgr.create_collective_group(
+        info["backend"], info["world_size"], info["ranks"][my_id],
+        group_name)
+
+
+def _get(group_name: str):
+    g = _group_mgr.get_group_by_name(group_name)
+    if g is None:
+        g = _lazy_join(group_name)
+    return g
+
+
+def get_group(group_name: str = "default"):
+    """The group handle (e.g. for ``global_mesh()`` on XLA groups)."""
+    return _get(group_name)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _group_mgr.destroy_collective_group(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    """This process's rank in the group; -1 if not a member (ref:
+    collective.py:223)."""
+    g = _group_mgr.get_group_by_name(group_name)
+    return g.rank if g is not None else -1
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    g = _group_mgr.get_group_by_name(group_name)
+    return g.world_size if g is not None else -1
+
+
+# ------------------------------------------------------------------ ops
+def allreduce(tensor, group_name: str = "default",
+              op: ReduceOp = ReduceOp.SUM):
+    """All-reduce across the group; RETURNS the reduced array (ref:
+    collective.py:258 — functional here, see module docstring)."""
+    return _get(group_name).allreduce(tensor, op)
+
+
+def allgather(tensor, group_name: str = "default") -> List[Any]:
+    """Gather every rank's tensor; returns the rank-ordered list."""
+    return _get(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: ReduceOp = ReduceOp.SUM):
+    """Reduce then return this rank's axis-0 shard."""
+    return _get(group_name).reducescatter(tensor, op)
+
+
+def broadcast(tensor, src_rank: int = 0,
+              group_name: str = "default"):
+    """Broadcast ``src_rank``'s tensor; returns it on every rank."""
+    return _get(group_name).broadcast(tensor, src_rank)
+
+
+def barrier(group_name: str = "default") -> None:
+    _get(group_name).barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    """Point-to-point send (CPU backend; XLA p2p is in-graph ppermute)."""
+    _get(group_name).send(tensor, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default",
+         timeout: float = 120.0):
+    """Blocking point-to-point receive from ``src_rank``."""
+    return _get(group_name).recv(src_rank, timeout=timeout)

@@ -91,10 +91,10 @@ class CPUGroup:
         self._peers: Dict[int, socket.socket] = {}
         self._p2p_in: Dict[int, "queue.Queue[Any]"] = {}
         self._p2p_lock = threading.Lock()
+        self._closed = False
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True)
         self._accept_thread.start()
-        self._closed = False
         store.set(f"col/{group_name}/{rank}",
                   f"{get_node_ip_address()}:{self._port}")
         if rank == 0:

@@ -140,7 +140,8 @@ class XLAGroup:
             "ppermute over a mesh axis (see ray_tpu.parallel); use the "
             "cpu backend for host p2p")
 
-    recv = send
+    def recv(self, src_rank: int, timeout: float = 120.0):
+        self.send(None, src_rank)
 
     def destroy(self) -> None:
         pass  # the jax world outlives groups by design

@@ -419,3 +419,32 @@ def cancel(ref: ObjectRef, *, force: bool = False) -> None:
 
 def get_actor(name: str, namespace: str = "") -> ActorHandle:
     return _runtime_mod.get_runtime().get_named_actor(name, namespace)
+
+
+class RuntimeContext:
+    """Introspection handle for the current process/task (ref:
+    python/ray/runtime_context.py RuntimeContext — get_job_id,
+    get_task_id, get_actor_id, get_node_id)."""
+
+    def __init__(self, rt):
+        self._rt = rt
+
+    def get_job_id(self) -> str:
+        return self._rt.job_id.hex()
+
+    def get_task_id(self):
+        tid = self._rt.current_task_id()
+        return tid.hex() if tid is not None else None
+
+    def get_actor_id(self):
+        aid = getattr(self._rt, "current_actor_id", None)
+        return aid.hex() if aid is not None else None
+
+    def get_node_id(self):
+        import os
+
+        return os.environ.get("RT_NODE_ID")
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(_runtime_mod.get_runtime())

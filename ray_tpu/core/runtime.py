@@ -68,6 +68,10 @@ class BaseRuntime(abc.ABC):
         self._put_counter = _Counter()
         self._actor_seq: Dict[ActorID, _Counter] = {}
         self._seq_lock = threading.Lock()
+        # Set by the worker when this process hosts an actor instance
+        # (read through api.get_runtime_context, ref:
+        # runtime_context.py get_actor_id).
+        self.current_actor_id: Optional[ActorID] = None
 
     # -- ID derivation ------------------------------------------------------
     def current_task_id(self) -> TaskID:

@@ -371,6 +371,7 @@ class Worker:
             asyncio.get_event_loop().call_later(0.2, self._exit_event.set)
             return {"ok": False, "error": repr(exc)}
         self.actor_id = spec.actor_id
+        self.runtime.current_actor_id = spec.actor_id
         self.actor_instance = instance
         n = max(1, spec.max_concurrency)
         self.actor_executor = ThreadPoolExecutor(

@@ -11,8 +11,11 @@ from __future__ import annotations
 import glob as _glob
 from typing import Any, Dict, Iterable, List, Optional
 
+from .aggregate import (AggregateFn, Count, Max, Mean, Min,  # noqa: F401
+                        Std, Sum)
 from .block import Block, BlockAccessor, build_block  # noqa: F401
 from .dataset import Dataset  # noqa: F401
+from .grouped_data import GroupedData  # noqa: F401
 
 
 def from_items(items: List[Any], *, parallelism: int = 4) -> Dataset:

@@ -11,7 +11,6 @@ gang and meshes from ray_tpu.parallel cover every chip.
 
 from __future__ import annotations
 
-import socket
 from typing import Dict, List
 
 import ray_tpu
@@ -33,49 +32,30 @@ class JaxBackend(Backend):
         if num == 1:
             return  # single-process jax needs no distributed init
 
-        def _bootstrap(rank: int, world: int, key: str):
-            import ray_tpu
-            from ray_tpu.core import runtime as _rt
+        def _bootstrap(rank: int, world: int, group_name: str):
+            # The gang IS an XLA collective group: jax.distributed
+            # bootstrap (coordinator rendezvous through the controller
+            # KV) lives in one place — the collective library — and
+            # training code can later grab the group's global_mesh().
+            from ray_tpu import collective as col
 
-            rt = _rt.get_runtime()
-            if rank == 0:
-                from ray_tpu.core.net import get_node_ip_address
-
-                s = socket.socket()
-                s.bind(("", 0))
-                port = s.getsockname()[1]
-                s.close()
-                coord = f"{get_node_ip_address()}:{port}"
-                rt.controller_call("kv_put", {"key": key,
-                                              "value": coord.encode()})
+            if col.is_group_initialized(group_name):
+                g = col.get_group(group_name)
             else:
-                import time
+                g = col.init_collective_group(world, rank,
+                                              backend="xla",
+                                              group_name=group_name)
+            return len(g.devices)
 
-                deadline = time.time() + 120
-                coord = None
-                while time.time() < deadline:
-                    raw = rt.controller_call("kv_get", {"key": key})
-                    if raw:
-                        coord = raw.decode()
-                        break
-                    time.sleep(0.05)
-                if coord is None:
-                    raise TimeoutError("jax coordinator never published")
-            import jax
-
-            jax.distributed.initialize(coordinator_address=coord,
-                                       num_processes=world,
-                                       process_id=rank)
-            return len(jax.devices())
-
-        key = f"train/{run_id}/jax_coordinator"
+        group_name = f"train/{run_id}"
         refs = []
         for w in worker_group.workers:
             from ..core import serialization
 
             payload = serialization.dumps_code(_bootstrap)
             refs.append(w.actor.run.remote(payload,
-                                           (w.rank, num, key), {}))
+                                           (w.rank, num, group_name),
+                                           {}))
         ray_tpu.get(refs, timeout=300)
 
     def on_shutdown(self, worker_group) -> None:

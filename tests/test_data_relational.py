@@ -1,0 +1,126 @@
+"""Dataset groupby/aggregate/sort/unique — the relational layer over
+the key-partitioned exchange, with byte-budgeted barrier submission.
+
+Ref: python/ray/data/grouped_data.py (GroupedData + AggregateFn),
+dataset.py:2472 (sort), _internal/planner/exchange/sort_task_spec.py
+(boundary sampling) — round-3 VERDICT item 5.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rtd
+from ray_tpu.data import Count, Max, Mean, Min, Std, Sum
+
+
+@pytest.fixture(scope="module")
+def rt():
+    runtime = ray_tpu.init(mode="cluster", num_cpus=4)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def _items(n=200, mod=7):
+    return [{"k": i % mod, "v": float(i)} for i in range(n)]
+
+
+def test_groupby_local_mode_no_runtime():
+    """Without a runtime the relational ops execute inline (this test
+    runs FIRST, before the module fixture starts the cluster)."""
+    ds = rtd.from_items(_items(40, mod=4), parallelism=2)
+    out = ds.groupby("k").sum("v").take_all()
+    assert len(out) == 4
+    assert ds.sort("v", descending=True).take_all()[0]["v"] == 39.0
+    mg = ds.groupby("k").map_groups(lambda rows: len(rows))
+    assert mg.take_all() == [10, 10, 10, 10]
+
+
+def test_groupby_count_sum_cluster(rt):
+    ds = rtd.from_items(_items(), parallelism=5)
+    out = ds.groupby("k").aggregate(Count(), Sum("v")).take_all()
+    assert len(out) == 7
+    # Keys are hash-partitioned: order is deterministic per partition
+    # but not globally sorted.
+    out.sort(key=lambda r: r["k"])
+    expect = {}
+    for row in _items():
+        c, s = expect.get(row["k"], (0, 0.0))
+        expect[row["k"]] = (c + 1, s + row["v"])
+    for r in out:
+        c, s = expect[r["k"]]
+        assert r["count()"] == c
+        assert r["sum(v)"] == pytest.approx(s)
+
+
+def test_groupby_mean_min_max_std(rt):
+    ds = rtd.from_items(_items(120, mod=4), parallelism=3)
+    out = ds.groupby("k").aggregate(Mean("v"), Min("v"), Max("v"),
+                                    Std("v")).take_all()
+    assert len(out) == 4
+    for r in out:
+        vals = [row["v"] for row in _items(120, mod=4)
+                if row["k"] == r["k"]]
+        assert r["mean(v)"] == pytest.approx(np.mean(vals))
+        assert r["min(v)"] == min(vals)
+        assert r["max(v)"] == max(vals)
+        assert r["std(v)"] == pytest.approx(np.std(vals, ddof=1))
+
+
+def test_groupby_key_function_and_chained_transform(rt):
+    ds = rtd.range(60, parallelism=4).map(
+        lambda r: {"id": r["id"], "bucket": r["id"] // 20})
+    out = ds.groupby(lambda r: r["bucket"]).count().take_all()
+    assert sorted((r["key"], r["count()"]) for r in out) == [
+        (0, 20), (1, 20), (2, 20)]
+
+
+def test_map_groups(rt):
+    ds = rtd.from_items(_items(60, mod=3), parallelism=4)
+    out = ds.groupby("k").map_groups(
+        lambda rows: {"k": rows[0]["k"],
+                      "span": max(r["v"] for r in rows)
+                      - min(r["v"] for r in rows)}).take_all()
+    assert len(out) == 3
+    for r in out:
+        vals = [row["v"] for row in _items(60, mod=3)
+                if row["k"] == r["k"]]
+        assert r["span"] == pytest.approx(max(vals) - min(vals))
+
+
+def test_sort_ascending_descending(rt):
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(300).tolist()
+    ds = rtd.from_items([{"v": int(v)} for v in vals], parallelism=6)
+    asc = [r["v"] for r in ds.sort("v").iter_rows()]
+    assert asc == sorted(vals)
+    desc = [r["v"] for r in ds.sort("v", descending=True).iter_rows()]
+    assert desc == sorted(vals, reverse=True)
+
+
+def test_sort_scalar_rows_and_key_fn(rt):
+    vals = [9, 3, 7, 1, 8, 2, 0, 6, 4, 5]
+    ds = rtd.from_items(vals, parallelism=3)
+    assert ds.sort().take_all() == sorted(vals)
+    assert ds.sort(lambda v: -v).take_all() == sorted(vals,
+                                                     reverse=True)
+
+
+def test_global_aggregate_and_unique(rt):
+    ds = rtd.from_items(_items(100, mod=5), parallelism=4)
+    agg = ds.aggregate(Count(), Mean("v"))
+    assert agg["count()"] == 100
+    assert agg["mean(v)"] == pytest.approx(np.mean(
+        [r["v"] for r in _items(100, mod=5)]))
+    assert ds.mean("v") == pytest.approx(49.5)
+    assert ds.min("v") == 0.0
+    assert ds.max("v") == 99.0
+    assert ds.std("v") == pytest.approx(np.std(
+        np.arange(100.0), ddof=1))
+    assert sorted(ds.unique("k")) == [0, 1, 2, 3, 4]
+
+
+def test_sort_empty_and_single_block(rt):
+    assert rtd.from_items([], parallelism=1).sort().take_all() == []
+    ds = rtd.from_items([3, 1, 2], parallelism=1)
+    assert ds.sort().take_all() == [1, 2, 3]

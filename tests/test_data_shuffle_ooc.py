@@ -70,3 +70,49 @@ def _shuffle_out_of_core_body():
     # The dataset is ~192MB; driver growth must stay far below it
     # (allow slack for allocator noise + one batch in flight).
     assert grew_mb < 80, f"driver RSS grew {grew_mb:.0f} MB"
+
+
+def test_groupby_sort_out_of_core_driver_rss_flat():
+    """groupby().aggregate() and sort() on a dataset ~4x the store,
+    driver RSS flat (round-3 VERDICT item 5 'done' bar): only (key,
+    accumulator) pairs and ObjectRefs touch the driver; row payloads
+    move map-task -> store -> reduce-task under the byte budget."""
+    import resource
+
+    ray_tpu.init(mode="cluster", num_cpus=2,
+                 config={"object_store_memory_bytes": 8 * 1024**2})
+    try:
+        n_blocks, rows_per_block = 8, 1000
+        ds = _indexed_dataset(n_blocks, rows_per_block,
+                              payload_cols=1024)
+        ds = ds.map(lambda r: {"k": r["i"] % 5, "i": r["i"],
+                               "payload": r["payload"]})
+        n = n_blocks * rows_per_block
+
+        rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+        from ray_tpu.data import Count, Sum
+
+        out = ds.groupby("k").aggregate(Count(), Sum("i")).take_all()
+        out.sort(key=lambda r: r["k"])
+        assert [r["count()"] for r in out] == [n // 5] * 5
+        assert sum(r["sum(i)"] for r in out) == n * (n - 1) // 2
+
+        # Sort the same payload-heavy dataset by descending id and
+        # stream it back: global order must hold across partitions.
+        prev = n
+        seen = 0
+        for batch in ds.sort("i", descending=True).iter_batches(
+                batch_size=1000):
+            ids = batch["i"].tolist()
+            assert ids == sorted(ids, reverse=True)
+            assert ids[0] <= prev
+            prev = ids[-1]
+            seen += len(ids)
+        assert seen == n
+
+        rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        grew_mb = (rss_after - rss_before) / 1024.0
+        assert grew_mb < 80, f"driver RSS grew {grew_mb:.0f} MB"
+    finally:
+        ray_tpu.shutdown()
