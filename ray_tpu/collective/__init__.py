@@ -204,7 +204,25 @@ def get_group(group_name: str = "default"):
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
+    """Tear down this process's membership AND the group's rendezvous
+    state in the KV (declaration + rank addresses), so the name can be
+    reused — the analogue of the reference killing the Info actor
+    (ref: collective.py:100-107).  Call from every member (or the
+    declaring driver) once the group is done."""
     _group_mgr.destroy_collective_group(group_name)
+    try:
+        store = KVStore()
+        from ray_tpu.core import runtime as _rt
+
+        rt = _rt.get_runtime()
+        for prefix in (_DECL_PREFIX + group_name,
+                       f"col/{group_name}/"):
+            for key in rt.controller_call("kv_keys",
+                                          {"prefix": prefix}):
+                store.delete(key)
+    except Exception:
+        logger.debug("KV cleanup for group %r failed", group_name,
+                     exc_info=True)
 
 
 def get_rank(group_name: str = "default") -> int:

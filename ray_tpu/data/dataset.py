@@ -565,40 +565,52 @@ class Dataset:
             inflight.append(probe(res))
         return results
 
+    def _exchange_stages(self, n_out: int,
+                         map_call: Callable[[int, Any], Any],
+                         reduce_call: Callable[[int, List[List[Any]]],
+                                               Any]) -> "Dataset":
+        """The one two-stage exchange scaffold every barrier shares:
+        ``map_call(i, src)`` submits one map task (returns its ref or
+        ref tuple), ``reduce_call(j, map_out)`` submits reduce j; both
+        stages run under the streaming byte budget."""
+
+        def norm(refs) -> List[Any]:
+            return [refs] if n_out == 1 else list(refs)
+
+        map_out = self._run_stage_bounded(
+            [lambda i=i, s=src: norm(map_call(i, s))
+             for i, src in enumerate(self._sources)],
+            probe=lambda refs: refs[0], size_factor=n_out)
+        reduce_refs = self._run_stage_bounded(
+            [lambda j=j: reduce_call(j, map_out)
+             for j in range(n_out)],
+            probe=lambda r: r)
+        return Dataset._from_refs(reduce_refs, self._window)
+
     def _exchange(self, n_out: int, assign: str, do_shuffle: bool,
                   seed: Optional[int],
                   key_spec: Union[str, Callable, None] = None,
                   boundaries: Optional[List[Any]] = None,
                   sort_spec: Optional[Tuple[Any, bool]] = None
                   ) -> "Dataset":
-        """Two-stage map/reduce exchange through the object plane, both
-        stages submission-bounded by the streaming byte budget."""
+        """Two-stage map/reduce exchange through the object plane."""
         import ray_tpu
 
         map_fn = ray_tpu.remote(_shuffle_map).options(
             num_returns=n_out)
         reduce_fn = ray_tpu.remote(_shuffle_reduce)
 
-        def map_thunk(i: int, src) -> List[Any]:
+        def map_call(i: int, src):
             mseed = None if seed is None else seed * 1000003 + i
-            refs = map_fn.remote(src, self._ops, n_out, assign, mseed,
+            return map_fn.remote(src, self._ops, n_out, assign, mseed,
                                  key_spec, boundaries)
-            return [refs] if n_out == 1 else list(refs)
 
-        map_out = self._run_stage_bounded(
-            [lambda i=i, s=src: map_thunk(i, s)
-             for i, src in enumerate(self._sources)],
-            probe=lambda refs: refs[0], size_factor=n_out)
-
-        def reduce_thunk(j: int):
+        def reduce_call(j: int, map_out):
             rseed = None if seed is None else seed * 7919 + j
             return reduce_fn.remote(rseed, do_shuffle, sort_spec,
                                     *[m[j] for m in map_out])
 
-        reduce_refs = self._run_stage_bounded(
-            [lambda j=j: reduce_thunk(j) for j in range(n_out)],
-            probe=lambda r: r)
-        return Dataset._from_refs(reduce_refs, self._window)
+        return self._exchange_stages(n_out, map_call, reduce_call)
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         if self._has_runtime():
@@ -727,22 +739,26 @@ class Dataset:
     def min(self, key: Optional[str] = None):
         from .aggregate import Min
 
-        return self.aggregate(Min(key))[Min(key).name]
+        agg = Min(key)
+        return self.aggregate(agg)[agg.name]
 
     def max(self, key: Optional[str] = None):
         from .aggregate import Max
 
-        return self.aggregate(Max(key))[Max(key).name]
+        agg = Max(key)
+        return self.aggregate(agg)[agg.name]
 
     def mean(self, key: Optional[str] = None):
         from .aggregate import Mean
 
-        return self.aggregate(Mean(key))[Mean(key).name]
+        agg = Mean(key)
+        return self.aggregate(agg)[agg.name]
 
     def std(self, key: Optional[str] = None, ddof: int = 1):
         from .aggregate import Std
 
-        return self.aggregate(Std(key, ddof))[Std(key).name]
+        agg = Std(key, ddof)
+        return self.aggregate(agg)[agg.name]
 
     # ------------------------------------------------------------- output
     def write_parquet(self, path: str) -> None:

@@ -67,21 +67,12 @@ class GroupedData:
         map_fn = ray_tpu.remote(_groupby_map).options(
             num_returns=n_out)
         reduce_fn = ray_tpu.remote(_groupby_reduce)
-
-        def map_thunk(src):
-            refs = map_fn.remote(src, ds._ops, n_out, self._key,
-                                 list(aggs))
-            return [refs] if n_out == 1 else list(refs)
-
-        map_out = ds._run_stage_bounded(
-            [lambda s=src: map_thunk(s) for src in ds._sources],
-            probe=lambda refs: refs[0], size_factor=n_out)
-        reduce_refs = ds._run_stage_bounded(
-            [lambda j=j: reduce_fn.remote(key_name, list(aggs),
-                                          *[m[j] for m in map_out])
-             for j in range(n_out)],
-            probe=lambda r: r)
-        return Dataset._from_refs(reduce_refs, ds._window)
+        return ds._exchange_stages(
+            n_out,
+            lambda _i, src: map_fn.remote(src, ds._ops, n_out,
+                                          self._key, list(aggs)),
+            lambda j, map_out: reduce_fn.remote(
+                key_name, list(aggs), *[m[j] for m in map_out]))
 
     # ---------------------------------------------------------- shortcuts
     def count(self) -> Dataset:
@@ -131,18 +122,9 @@ class GroupedData:
         map_fn = ray_tpu.remote(_shuffle_map).options(
             num_returns=n_out)
         reduce_fn = ray_tpu.remote(_map_groups_reduce)
-
-        def map_thunk(src):
-            refs = map_fn.remote(src, ds._ops, n_out, "hash", None,
-                                 self._key, None)
-            return [refs] if n_out == 1 else list(refs)
-
-        map_out = ds._run_stage_bounded(
-            [lambda s=src: map_thunk(s) for src in ds._sources],
-            probe=lambda refs: refs[0], size_factor=n_out)
-        reduce_refs = ds._run_stage_bounded(
-            [lambda j=j: reduce_fn.remote(self._key, fn,
-                                          *[m[j] for m in map_out])
-             for j in range(n_out)],
-            probe=lambda r: r)
-        return Dataset._from_refs(reduce_refs, ds._window)
+        return ds._exchange_stages(
+            n_out,
+            lambda _i, src: map_fn.remote(src, ds._ops, n_out, "hash",
+                                          None, self._key, None),
+            lambda j, map_out: reduce_fn.remote(
+                self._key, fn, *[m[j] for m in map_out]))

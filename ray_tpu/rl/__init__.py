@@ -8,6 +8,14 @@ SURVEY.md §7 hard-parts note).
 
 from .actor_manager import FaultTolerantActorManager  # noqa: F401
 from .algorithm import PPO, AlgorithmConfig  # noqa: F401
+from .connectors import (ClipActions, ConnectorPipelineV2,  # noqa
+                         ConnectorV2, FlattenObs, NormalizeObs,
+                         RescaleActions)
+from .offline import (BC, BCConfig, BCJaxLearner, OfflineData,  # noqa
+                      record_rollouts)
+from .sac import (SAC, SACConfig, SACEnvRunner, SACJaxLearner,  # noqa
+                  SACTrainConfig, ContinuousModuleSpec,
+                  ContinuousReplayBuffer)
 from .dqn import (DQN, DQNConfig, DQNEnvRunner, DQNJaxLearner,  # noqa
                   DQNTrainConfig, ReplayBuffer)
 from .env_runner import EnvRunnerGroup, SingleAgentEnvRunner  # noqa

@@ -1,0 +1,219 @@
+"""Offline RL data path: record rollouts, read them back as a Dataset,
+train from them (behavior cloning).
+
+Role-equivalent to the reference's offline stack (ref:
+rllib/offline/offline_data.py — OfflineData wraps a ray.data Dataset
+and hands the learner an iterator of train batches;
+offline/offline_env_runner.py records sampled experience to Parquet).
+The TPU framing is identical in shape: transitions flow through
+ray_tpu.data (Parquet blocks, streaming iteration), and the learner's
+update_from_batch consumes numpy batch dicts.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .rl_module import RLModuleSpec
+
+
+def record_rollouts(env_fn: Callable, policy_fn: Callable,
+                    path: str, *, num_steps: int = 2000,
+                    seed: int = 0) -> int:
+    """Roll a (behavior) policy and write transitions to Parquet via
+    ray_tpu.data (ref: offline_env_runner.py output writing).
+    ``policy_fn(obs) -> action`` is any callable — a scripted expert, a
+    trained module, or random.  Returns rows written."""
+    import gymnasium as gym  # noqa: F401 — envs come from env_fn
+
+    from ray_tpu import data as rt_data
+
+    env = env_fn()
+    obs, _ = env.reset(seed=seed)
+    rows: List[Dict[str, Any]] = []
+    for _ in range(num_steps):
+        action = policy_fn(np.asarray(obs, np.float32))
+        next_obs, reward, term, trunc, _ = env.step(action)
+        rows.append({
+            "obs": np.asarray(obs, np.float32),
+            "action": action,
+            "reward": float(reward),
+            "done": float(term),
+        })
+        obs = next_obs
+        if term or trunc:
+            obs, _ = env.reset()
+    ds = rt_data.from_items(rows, parallelism=max(1, len(rows) // 500))
+    ds.write_parquet(path)
+    return len(rows)
+
+
+class OfflineData:
+    """Streaming batch source over recorded experience (ref:
+    offline_data.py OfflineData.sample — returns batch iterators over
+    the underlying Dataset, repeating across epochs)."""
+
+    def __init__(self, path_or_dataset, *, shuffle_seed: int = 0):
+        from ray_tpu import data as rt_data
+        from ray_tpu.data.dataset import Dataset
+
+        if isinstance(path_or_dataset, Dataset):
+            self.dataset = path_or_dataset
+        else:
+            self.dataset = rt_data.read_parquet(path_or_dataset)
+        self._seed = shuffle_seed
+
+    def count(self) -> int:
+        return self.dataset.count()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     epochs: Optional[int] = None
+                     ) -> Iterator[Dict[str, np.ndarray]]:
+        """Epoch-shuffled numpy batches, forever when epochs=None."""
+        epoch = 0
+        while epochs is None or epoch < epochs:
+            shuffled = self.dataset.random_shuffle(
+                seed=self._seed + epoch)
+            yielded = 0
+            for batch in shuffled.iter_batches(batch_size=batch_size,
+                                               batch_format="numpy",
+                                               drop_last=True):
+                yield batch
+                yielded += 1
+            if yielded == 0:
+                # drop_last with a dataset smaller than one batch
+                # would otherwise spin forever yielding nothing.
+                raise ValueError(
+                    f"offline dataset has fewer rows than "
+                    f"batch_size={batch_size}; record more data or "
+                    f"shrink the batch")
+            epoch += 1
+
+
+class BCJaxLearner:
+    """Behavior cloning: maximize log pi(a_behavior | s) (ref:
+    rllib/algorithms/bc/bc.py — BC is marl's simplest offline
+    algorithm, a supervised cross-entropy on the recorded actions)."""
+
+    def __init__(self, module_spec: RLModuleSpec, lr: float = 1e-3,
+                 seed: int = 0):
+        import jax
+        import optax
+
+        from .rl_module import JaxRLModule
+
+        self.module = JaxRLModule(module_spec)
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self.optimizer = optax.adam(lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update_fn = None
+        self.num_updates = 0
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        module = self.module
+
+        def loss_fn(params, obs, actions):
+            logits, _ = module.forward_train(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, actions[:, None], axis=-1)[:, 0]
+            acc = jnp.mean(
+                (jnp.argmax(logits, -1) == actions).astype(jnp.float32))
+            return jnp.mean(nll), acc
+
+        def update(params, opt_state, obs, actions):
+            (loss, acc), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, obs, actions)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss, "accuracy": acc}
+
+        return jax.jit(update)
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]
+                          ) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        if self._update_fn is None:
+            self._update_fn = self._build_update()
+        obs = jnp.asarray(np.stack(batch["obs"])
+                          if batch["obs"].dtype == object
+                          else batch["obs"], jnp.float32)
+        actions = jnp.asarray(batch["action"], jnp.int32)
+        self.params, self.opt_state, metrics = self._update_fn(
+            self.params, self.opt_state, obs, actions)
+        self.num_updates += 1
+        return {k: float(v)
+                for k, v in jax.device_get(metrics).items()}
+
+
+@dataclass
+class BCConfig:
+    input_path: Optional[str] = None
+    observation_dim: int = 0
+    action_dim: int = 0
+    hidden: tuple = (64, 64)
+    lr: float = 1e-3
+    train_batch_size: int = 256
+    updates_per_iteration: int = 50
+
+    def offline_data(self, input_path: str, *, observation_dim: int,
+                     action_dim: int):
+        return replace(self, input_path=input_path,
+                       observation_dim=observation_dim,
+                       action_dim=action_dim)
+
+    def training(self, **kw):
+        return replace(self, **kw)
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class BC:
+    """Offline training loop over OfflineData (ref: bc.py training_step
+    — sample from offline data, update, report)."""
+
+    def __init__(self, config: BCConfig):
+        assert config.input_path is not None, "offline_data(...) first"
+        self.config = config
+        spec = RLModuleSpec(config.observation_dim, config.action_dim,
+                            config.hidden)
+        self.learner = BCJaxLearner(spec, lr=config.lr)
+        self.data = OfflineData(config.input_path)
+        self._batches = self.data.iter_batches(
+            batch_size=config.train_batch_size)
+        self.iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        metrics: Dict[str, float] = {}
+        for _ in range(self.config.updates_per_iteration):
+            metrics = self.learner.update_from_batch(
+                next(self._batches))
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "time_this_iter_s": time.perf_counter() - t0,
+                **metrics}
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def stop(self) -> None:
+        pass

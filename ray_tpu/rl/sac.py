@@ -1,0 +1,521 @@
+"""SAC — soft actor-critic for continuous action spaces.
+
+Role-equivalent to the reference's SAC (ref:
+rllib/algorithms/sac/sac.py + sac_learner.py/default_sac_rl_module.py —
+squashed-Gaussian actor, twin Q critics, polyak-averaged targets, and
+automatic entropy-temperature tuning toward -|A| target entropy; the
+public algorithm is Haarnoja et al. 2018).  JAX shape: actor, critic,
+and alpha updates compile into ONE jitted step (the reference runs
+three torch optimizers sequentially); the env runner feeds through
+ConnectorV2 pipelines (obs normalization in, action rescaling out), so
+the module always sees normalized obs and emits [-1, 1] actions.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+
+from .connectors import (ClipActions, ConnectorPipelineV2, FlattenObs,
+                         RescaleActions)
+
+
+@dataclass(frozen=True)
+class ContinuousModuleSpec:
+    """Actor-critic spec for Box action spaces (ref: the SACModule's
+    (pi, qf, qf_twin) catalog in default_sac_rl_module.py)."""
+
+    observation_dim: int
+    action_dim: int
+    hidden: Tuple[int, ...] = (256, 256)
+    log_std_bounds: Tuple[float, float] = (-10.0, 2.0)
+
+
+class SACModule:
+    """Squashed-Gaussian policy + twin Q functions, pure-functional."""
+
+    def __init__(self, spec: ContinuousModuleSpec):
+        import flax.linen as nn
+
+        self.spec = spec
+
+        class Actor(nn.Module):
+            @nn.compact
+            def __call__(self, obs):
+                x = obs
+                for i, h in enumerate(spec.hidden):
+                    x = nn.relu(nn.Dense(h, name=f"fc_{i}")(x))
+                mean = nn.Dense(spec.action_dim, name="mean")(x)
+                log_std = nn.Dense(spec.action_dim, name="log_std")(x)
+                return mean, log_std
+
+        class Critic(nn.Module):
+            @nn.compact
+            def __call__(self, obs, act):
+                import jax.numpy as jnp
+
+                x = jnp.concatenate([obs, act], axis=-1)
+                for i, h in enumerate(spec.hidden):
+                    x = nn.relu(nn.Dense(h, name=f"fc_{i}")(x))
+                return nn.Dense(1, name="q")(x)[..., 0]
+
+        self.actor = Actor()
+        self.critic = Critic()
+
+    def init(self, rng) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        k1, k2, k3 = jax.random.split(rng, 3)
+        obs = jnp.zeros((1, self.spec.observation_dim))
+        act = jnp.zeros((1, self.spec.action_dim))
+        return {
+            "actor": self.actor.init(k1, obs),
+            "q1": self.critic.init(k2, obs, act),
+            "q2": self.critic.init(k3, obs, act),
+        }
+
+    def sample_action(self, actor_params, obs, rng):
+        """Reparameterized tanh-squashed sample with its log-prob
+        (change-of-variables correction; ref: SAC appendix C)."""
+        import jax
+        import jax.numpy as jnp
+
+        mean, log_std = self.actor.apply(actor_params, obs)
+        lo, hi = self.spec.log_std_bounds
+        log_std = jnp.clip(log_std, lo, hi)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(rng, mean.shape)
+        pre_tanh = mean + std * eps
+        action = jnp.tanh(pre_tanh)
+        gauss_logp = (-0.5 * ((eps) ** 2 + 2 * log_std
+                              + jnp.log(2 * jnp.pi))).sum(-1)
+        # d tanh(x)/dx = 1 - tanh^2(x); stable form via softplus.
+        squash = (2.0 * (jnp.log(2.0) - pre_tanh
+                         - jax.nn.softplus(-2.0 * pre_tanh))).sum(-1)
+        return action, gauss_logp - squash
+
+    def deterministic_action(self, actor_params, obs):
+        import jax.numpy as jnp
+
+        mean, _ = self.actor.apply(actor_params, obs)
+        return jnp.tanh(mean)
+
+    def q_values(self, params, obs, act):
+        return (self.critic.apply(params["q1"], obs, act),
+                self.critic.apply(params["q2"], obs, act))
+
+
+@dataclass
+class SACTrainConfig:
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005                 # polyak target rate
+    initial_alpha: float = 1.0
+    target_entropy: Optional[float] = None   # default: -action_dim
+    buffer_capacity: int = 100_000
+    learning_starts: int = 1000
+    train_batch_size: int = 256
+    updates_per_iteration: int = 32
+
+
+class SACJaxLearner:
+    """One jitted step = critic + actor + alpha updates + polyak sync
+    (ref: sac_learner.py compute_loss_for_module split into three
+    optimizers; fused here — XLA sees one graph)."""
+
+    def __init__(self, module_spec: ContinuousModuleSpec,
+                 config: Optional[SACTrainConfig] = None,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.cfg = config or SACTrainConfig()
+        self.module = SACModule(module_spec)
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self.target_params = {"q1": self.params["q1"],
+                              "q2": self.params["q2"]}
+        self.log_alpha = jnp.asarray(
+            np.log(self.cfg.initial_alpha), jnp.float32)
+        self.target_entropy = (self.cfg.target_entropy
+                               if self.cfg.target_entropy is not None
+                               else -float(module_spec.action_dim))
+        self.actor_opt = optax.adam(self.cfg.actor_lr)
+        self.critic_opt = optax.adam(self.cfg.critic_lr)
+        self.alpha_opt = optax.adam(self.cfg.alpha_lr)
+        self.opt_state = {
+            "actor": self.actor_opt.init(self.params["actor"]),
+            "critic": self.critic_opt.init(
+                {"q1": self.params["q1"], "q2": self.params["q2"]}),
+            "alpha": self.alpha_opt.init(self.log_alpha),
+        }
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self._update_fn = None
+        self.num_updates = 0
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> bool:
+        import jax
+
+        self.params = jax.device_put(params)
+        return True
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.cfg
+        module = self.module
+        target_entropy = self.target_entropy
+
+        def critic_loss(qs, actor_params, targets, log_alpha, batch,
+                        rng):
+            q1 = module.critic.apply(qs["q1"], batch["obs"],
+                                     batch["actions"])
+            q2 = module.critic.apply(qs["q2"], batch["obs"],
+                                     batch["actions"])
+            next_a, next_logp = module.sample_action(
+                actor_params, batch["next_obs"], rng)
+            tq1 = module.critic.apply(targets["q1"],
+                                      batch["next_obs"], next_a)
+            tq2 = module.critic.apply(targets["q2"],
+                                      batch["next_obs"], next_a)
+            alpha = jnp.exp(log_alpha)
+            soft_q = jnp.minimum(tq1, tq2) - alpha * next_logp
+            target = batch["rewards"] + cfg.gamma * \
+                (1.0 - batch["dones"]) * soft_q
+            target = jax.lax.stop_gradient(target)
+            return 0.5 * (jnp.mean((q1 - target) ** 2)
+                          + jnp.mean((q2 - target) ** 2))
+
+        def actor_loss(actor_params, qs, log_alpha, batch, rng):
+            a, logp = module.sample_action(actor_params, batch["obs"],
+                                           rng)
+            q1 = module.critic.apply(qs["q1"], batch["obs"], a)
+            q2 = module.critic.apply(qs["q2"], batch["obs"], a)
+            alpha = jnp.exp(log_alpha)
+            return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+        def step(params, targets, log_alpha, opt_state, rng, batch):
+            rng, k_critic, k_actor = jax.random.split(rng, 3)
+            qs = {"q1": params["q1"], "q2": params["q2"]}
+            closs, cgrads = jax.value_and_grad(critic_loss)(
+                qs, params["actor"], targets, log_alpha, batch,
+                k_critic)
+            cupd, new_copt = self.critic_opt.update(
+                cgrads, opt_state["critic"], qs)
+            qs = optax.apply_updates(qs, cupd)
+            (aloss, logp), agrads = jax.value_and_grad(
+                actor_loss, has_aux=True)(params["actor"], qs,
+                                          log_alpha, batch, k_actor)
+            aupd, new_aopt = self.actor_opt.update(
+                agrads, opt_state["actor"], params["actor"])
+            new_actor = optax.apply_updates(params["actor"], aupd)
+            # Alpha toward target entropy (ref: sac_learner.py alpha
+            # loss -log_alpha * (logp + target_entropy)).
+            def alpha_loss(la):
+                return -jnp.mean(la * jax.lax.stop_gradient(
+                    logp + target_entropy))
+
+            lloss, lgrad = jax.value_and_grad(alpha_loss)(log_alpha)
+            lupd, new_lopt = self.alpha_opt.update(
+                lgrad, opt_state["alpha"], log_alpha)
+            new_log_alpha = optax.apply_updates(log_alpha, lupd)
+            new_targets = jax.tree_util.tree_map(
+                lambda t, o: (1 - cfg.tau) * t + cfg.tau * o,
+                targets, qs)
+            new_params = {"actor": new_actor, **qs}
+            new_opt = {"actor": new_aopt, "critic": new_copt,
+                       "alpha": new_lopt}
+            metrics = {"critic_loss": closs, "actor_loss": aloss,
+                       "alpha": jnp.exp(new_log_alpha),
+                       "entropy": -jnp.mean(logp)}
+            return (new_params, new_targets, new_log_alpha, new_opt,
+                    rng, metrics)
+
+        return jax.jit(step)
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]
+                          ) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        if self._update_fn is None:
+            self._update_fn = self._build_update()
+        dev = {k: jnp.asarray(v, jnp.float32) for k, v in batch.items()
+               if k in ("obs", "actions", "rewards", "dones",
+                        "next_obs")}
+        (self.params, self.target_params, self.log_alpha,
+         self.opt_state, self._rng, metrics) = self._update_fn(
+            self.params, self.target_params, self.log_alpha,
+            self.opt_state, self._rng, dev)
+        self.num_updates += 1
+        return {k: float(v)
+                for k, v in jax.device_get(metrics).items()}
+
+
+class SACEnvRunner:
+    """Continuous-action collector over a vector env, with ConnectorV2
+    pipelines on both paths (ref: single_agent_env_runner.py driving
+    env_to_module / module_to_env pipelines)."""
+
+    def __init__(self, env_fn: Callable,
+                 module_spec: ContinuousModuleSpec,
+                 num_envs: int = 1, seed: int = 0,
+                 env_to_module: Optional[ConnectorPipelineV2] = None,
+                 module_to_env: Optional[ConnectorPipelineV2] = None):
+        import gymnasium as gym
+
+        self.envs = gym.vector.SyncVectorEnv(
+            [lambda: env_fn() for _ in range(num_envs)],
+            autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+        self.num_envs = num_envs
+        self.module = SACModule(module_spec)
+        self.params = None
+        low = self.envs.single_action_space.low
+        high = self.envs.single_action_space.high
+        self.env_to_module = env_to_module or ConnectorPipelineV2(
+            [FlattenObs()])
+        self.module_to_env = module_to_env or ConnectorPipelineV2(
+            [RescaleActions(low, high), ClipActions(low, high)])
+        self._sample_fn = None
+        import jax
+
+        self._rng = jax.random.PRNGKey(seed)
+        self._obs, _ = self.envs.reset(seed=seed)
+        self._episode_returns = np.zeros(num_envs)
+        self._completed: List[float] = []
+
+    def set_weights(self, params) -> bool:
+        import jax
+
+        self.params = jax.device_put(params)
+        if self._sample_fn is None:
+            self._sample_fn = jax.jit(self.module.sample_action)
+        return True
+
+    def connector_states(self) -> Dict[str, Any]:
+        return {"env_to_module": self.env_to_module.get_state(),
+                "module_to_env": self.module_to_env.get_state()}
+
+    def sample(self, num_steps: int, random_actions: bool = False
+               ) -> Dict[str, np.ndarray]:
+        """Returns transitions with MODULE-frame actions in [-1, 1]
+        (what the learner trains on); env-frame actions exist only
+        transiently on the module_to_env path."""
+        import jax
+
+        assert self.params is not None or random_actions
+        obs_b, act_b, rew_b, done_b, next_b = [], [], [], [], []
+        for _ in range(num_steps):
+            mod_obs = self.env_to_module({"obs": self._obs})["obs"]
+            if random_actions:
+                action = np.random.uniform(
+                    -1.0, 1.0, (self.num_envs,
+                                self.module.spec.action_dim)
+                ).astype(np.float32)
+            else:
+                self._rng, key = jax.random.split(self._rng)
+                a, _ = self._sample_fn(self.params["actor"], mod_obs,
+                                       key)
+                action = np.asarray(a)
+            env_action = self.module_to_env(
+                {"actions": action})["actions"]
+            next_obs, reward, term, trunc, info = self.envs.step(
+                env_action)
+            done = np.logical_or(term, trunc)
+            stored_next = next_obs
+            if done.any() and info.get("final_obs") is not None:
+                stored_next = np.array(next_obs, copy=True)
+                for i in np.nonzero(done)[0]:
+                    fo = info["final_obs"][i]
+                    if fo is not None:
+                        stored_next[i] = np.asarray(fo)
+            # Store the MODULE-frame view of both obs and action.
+            next_mod = self.env_to_module({"obs": stored_next})["obs"]
+            obs_b.append(mod_obs)
+            act_b.append(action)
+            rew_b.append(reward)
+            done_b.append(term)      # bootstrap through truncation
+            next_b.append(next_mod)
+            self._episode_returns += reward
+            for i, d in enumerate(done):
+                if d:
+                    self._completed.append(
+                        float(self._episode_returns[i]))
+                    self._episode_returns[i] = 0.0
+            self._obs = next_obs
+        return {
+            "obs": np.concatenate(obs_b).astype(np.float32),
+            "actions": np.concatenate(act_b).astype(np.float32),
+            "rewards": np.concatenate(rew_b).astype(np.float32),
+            "dones": np.concatenate(done_b).astype(np.float32),
+            "next_obs": np.concatenate(next_b).astype(np.float32),
+        }
+
+    def episode_stats(self, window: int = 20) -> Dict[str, float]:
+        recent = self._completed[-window:]
+        return {"episodes_total": len(self._completed),
+                "episode_return_mean":
+                    float(np.mean(recent)) if recent else 0.0}
+
+
+class ContinuousReplayBuffer:
+    """Ring buffer with float action vectors (the DQN buffer stores
+    int action scalars)."""
+
+    def __init__(self, capacity: int, obs_dim: int, act_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros((capacity, act_dim), np.float32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self._pos = 0
+        self._size = 0
+
+    def add_batch(self, tr: Dict[str, np.ndarray]) -> None:
+        n = len(tr["actions"])
+        idx = (self._pos + np.arange(n)) % self.capacity
+        self.obs[idx] = tr["obs"]
+        self.next_obs[idx] = tr["next_obs"]
+        self.actions[idx] = tr["actions"]
+        self.rewards[idx] = tr["rewards"]
+        self.dones[idx] = tr["dones"]
+        self._pos = (self._pos + n) % self.capacity
+        self._size = min(self._size + n, self.capacity)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def sample(self, rng: np.random.Generator, batch_size: int
+               ) -> Dict[str, np.ndarray]:
+        idx = rng.integers(0, self._size, batch_size)
+        return {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
+                "actions": self.actions[idx],
+                "rewards": self.rewards[idx],
+                "dones": self.dones[idx]}
+
+
+@dataclass
+class SACConfig:
+    env_fn: Optional[Callable] = None
+    observation_dim: int = 0
+    action_dim: int = 0
+    hidden: tuple = (256, 256)
+    num_env_runners: int = 1
+    num_envs_per_runner: int = 1
+    rollout_length: int = 64
+    reward_scale: float = 1.0
+    train: SACTrainConfig = field(default_factory=SACTrainConfig)
+
+    def environment(self, env_fn, *, observation_dim, action_dim,
+                    reward_scale: float = 1.0):
+        return replace(self, env_fn=env_fn,
+                       observation_dim=observation_dim,
+                       action_dim=action_dim,
+                       reward_scale=reward_scale)
+
+    def env_runners(self, **kw):
+        return replace(self, **kw)
+
+    def training(self, **kw):
+        return replace(self, train=replace(self.train, **kw))
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    """Off-policy control loop: runner actors collect → replay →
+    fused learner updates → weight sync (ref: sac.py
+    training_step shape shared with DQN)."""
+
+    def __init__(self, config: SACConfig):
+        assert config.env_fn is not None
+        self.config = config
+        spec = ContinuousModuleSpec(config.observation_dim,
+                                    config.action_dim, config.hidden)
+        from ..core import serialization
+
+        from .actor_manager import FaultTolerantActorManager
+
+        serialization.ensure_code_portable(config.env_fn)
+        self.learner = SACJaxLearner(spec, config.train)
+        runner_cls = ray_tpu.remote(SACEnvRunner)
+
+        def factory(i):
+            return runner_cls.remote(config.env_fn, spec,
+                                     config.num_envs_per_runner,
+                                     seed=4000 + 37 * i)
+
+        def on_restore(actor):
+            ray_tpu.get(actor.set_weights.remote(
+                self.learner.get_weights()), timeout=120)
+
+        self._runners = FaultTolerantActorManager(
+            factory, config.num_env_runners, on_restore=on_restore)
+        self._runners.foreach("set_weights",
+                              self.learner.get_weights())
+        self.buffer = ContinuousReplayBuffer(
+            config.train.buffer_capacity, config.observation_dim,
+            config.action_dim)
+        self._rng = np.random.default_rng(11)
+        self.env_steps_total = 0
+        self.iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        warmup = self.env_steps_total < cfg.train.learning_starts
+        results = self._runners.foreach("sample", cfg.rollout_length,
+                                        warmup)
+        self._runners.restore_unhealthy()
+        for r in results:
+            if r.ok:
+                tr = r.value
+                if cfg.reward_scale != 1.0:
+                    tr = {**tr,
+                          "rewards": tr["rewards"] * cfg.reward_scale}
+                self.buffer.add_batch(tr)
+                self.env_steps_total += len(tr["actions"])
+        metrics: Dict[str, float] = {}
+        if len(self.buffer) >= cfg.train.learning_starts:
+            for _ in range(cfg.train.updates_per_iteration):
+                batch = self.buffer.sample(
+                    self._rng, cfg.train.train_batch_size)
+                metrics = self.learner.update_from_batch(batch)
+            self._runners.foreach("set_weights",
+                                  self.learner.get_weights())
+            self._runners.restore_unhealthy()
+        self.iteration += 1
+        stats = [r.value for r in
+                 self._runners.foreach("episode_stats", 20) if r.ok]
+        return {
+            "training_iteration": self.iteration,
+            "env_steps_total": self.env_steps_total,
+            "episode_return_mean": float(np.mean(
+                [s["episode_return_mean"] for s in stats]))
+            if stats else 0.0,
+            "time_this_iter_s": time.perf_counter() - t0,
+            **metrics,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def stop(self) -> None:
+        self._runners.shutdown()

@@ -252,3 +252,26 @@ def test_get_runtime_context_actor_id(rt):
     # Driver process is not an actor.
     assert ray_tpu.get_runtime_context().get_actor_id() is None
     assert ray_tpu.get_runtime_context().get_job_id()
+
+
+def test_destroy_allows_group_name_reuse(rt):
+    """destroy_collective_group clears the KV declaration + rank
+    addresses so the name is reusable (ref: collective.py:100 killing
+    the Info actor on destroy)."""
+    from ray_tpu import collective as col
+
+    name = "grp_reuse"
+    actors = _spawn(2)
+    col.create_collective_group(actors, 2, [0, 1], backend="cpu",
+                                group_name=name)
+    outs = ray_tpu.get([a.allreduce.remote(name, 1.0)
+                        for a in actors], timeout=120)
+    np.testing.assert_allclose(outs[0], np.full(4, 2.0))
+    col.destroy_collective_group(name)
+    # Fresh actors, same name: must redeclare and work again.
+    actors2 = _spawn(2)
+    col.create_collective_group(actors2, 2, [0, 1], backend="cpu",
+                                group_name=name)
+    outs2 = ray_tpu.get([a.allreduce.remote(name, 2.0)
+                         for a in actors2], timeout=120)
+    np.testing.assert_allclose(outs2[0], np.full(4, 4.0))
