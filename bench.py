@@ -92,13 +92,15 @@ def main() -> None:
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     peak = _PEAK_FLOPS.get(gen, _PEAK_FLOPS["v5e"])
     mfu = tok_s * flops_per_token / peak if on_tpu else 0.0
-    print(json.dumps({
+    out = {
         "metric": "gpt2_124m_pretrain_tokens_per_sec_per_chip"
         if on_tpu else "gpt2_scaled_cpu_tokens_per_sec",
         "value": round(tok_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
-    }))
+    }
+    print(json.dumps(out))
+    _maybe_record(out)
 
 
 def long_context() -> None:
@@ -175,7 +177,7 @@ def long_context() -> None:
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     peak = _PEAK_FLOPS.get(gen, _PEAK_FLOPS["v5e"])
     mfu = ring_tok_s_chip * flops_tok / peak if on_tpu else 0.0
-    print(json.dumps({
+    out = {
         "metric": f"ring_attention_seq{t}_tokens_per_sec_per_chip"
         + ("" if on_tpu else "_cpu"),
         "value": round(ring_tok_s_chip, 1),
@@ -184,7 +186,23 @@ def long_context() -> None:
         "extra": {"dense_flash_tokens_per_sec": round(dense_tok_s, 1),
                   "ring_devices": len(dev),
                   "ring_attention_mfu": round(mfu, 4)},
-    }))
+    }
+    print(json.dumps(out))
+    _maybe_record(out)
+
+
+def _maybe_record(out: dict) -> None:
+    """--record: append to the PERF.jsonl round-over-round regression
+    ledger (tests/test_perf_ledger.py guards >20% drops)."""
+    import sys
+
+    if "--record" not in sys.argv:
+        return
+    from ray_tpu.util import perf_ledger
+
+    perf_ledger.record(
+        [{"benchmark": out["metric"], "value": out["value"],
+          "unit": out["unit"]}], source="bench")
 
 
 if __name__ == "__main__":

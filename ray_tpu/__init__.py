@@ -72,6 +72,16 @@ def init(
     if log_to_driver is not None:
         overrides["log_to_driver"] = log_to_driver
     cfg = RuntimeConfig.from_env(overrides)
+    if address and address.startswith("rt://"):
+        # Remote driver: one connection to the head's ClientServer; no
+        # cluster-routable agent needed on this machine (ref:
+        # util/client/ARCHITECTURE.md).
+        from .client.runtime import ClientRuntime
+
+        rt = ClientRuntime(cfg, address[len("rt://"):])
+        _runtime_mod.set_runtime(rt)
+        atexit.register(_shutdown_quiet)
+        return rt
     if mode == "auto":
         import importlib.util
 

@@ -42,6 +42,10 @@ class NodeTypeSpec:
     # slice-level label resources, like the reference's TPU-pod-head).
     hosts_per_slice: int = 1
     setup_commands: List[str] = field(default_factory=list)
+    # provider.type gcp: the TPU VM shape this node type creates (ref:
+    # autoscaler/_private/gcp/config.py node_config acceleratorType).
+    accelerator_type: Optional[str] = None
+    runtime_version: str = "tpu-ubuntu2204-base"
 
 
 @dataclass
@@ -68,6 +72,19 @@ class ClusterSpec:
     worker_start_command: str = DEFAULT_WORKER_START
     idle_timeout_s: float = 60.0
     env: Dict[str, str] = field(default_factory=dict)
+    # provider.type gcp: project/zone/api options (ref:
+    # autoscaler/_private/gcp/config.py provider section).
+    gcp: Dict[str, Any] = field(default_factory=dict)
+
+    def runner_type(self) -> str:
+        """How setup/start commands reach a host: 'subprocess' for
+        hermetic local execution, 'ssh' otherwise.  GCP clusters may
+        force subprocess for tests (provider.bootstrap_runner)."""
+        if self.provider_type == "subprocess":
+            return "subprocess"
+        if self.gcp.get("bootstrap_runner") == "subprocess":
+            return "subprocess"
+        return "ssh"
 
     # ------------------------------------------------------------ helpers
     def head_type(self) -> NodeTypeSpec:
@@ -116,9 +133,20 @@ def parse_cluster_spec(raw: Dict[str, Any]) -> ClusterSpec:
             raise ValueError(f"cluster spec missing required key {req!r}")
     prov = raw["provider"]
     ptype = prov.get("type", "ssh")
-    if ptype not in ("ssh", "subprocess"):
+    if ptype not in ("ssh", "subprocess", "gcp"):
         raise ValueError(f"unknown provider.type {ptype!r} "
-                         "(expected 'ssh' or 'subprocess')")
+                         "(expected 'ssh', 'subprocess' or 'gcp')")
+    gcp_cfg: Dict[str, Any] = {}
+    if ptype == "gcp":
+        for req in ("project_id", "zone"):
+            if req not in prov:
+                raise ValueError(
+                    f"provider.type gcp requires provider.{req}")
+        gcp_cfg = {k: prov[k] for k in
+                   ("project_id", "zone", "api_base",
+                    "use_queued_resources", "bootstrap_runner",
+                    "access_token", "poll_interval_s",
+                    "create_timeout_s") if k in prov}
 
     node_types: Dict[str, NodeTypeSpec] = {}
     for name, nt in raw["available_node_types"].items():
@@ -131,6 +159,9 @@ def parse_cluster_spec(raw: Dict[str, Any]) -> ClusterSpec:
                                    nt.get("min_workers", 0))),
             hosts_per_slice=int(nt.get("hosts_per_slice", 1)),
             setup_commands=_as_cmd_list(nt.get("setup_commands")),
+            accelerator_type=nt.get("accelerator_type"),
+            runtime_version=str(nt.get("runtime_version",
+                                       "tpu-ubuntu2204-base")),
         )
     head_type = raw["head_node_type"]
     if head_type not in node_types:
@@ -196,4 +227,5 @@ def parse_cluster_spec(raw: Dict[str, Any]) -> ClusterSpec:
             raw.get("worker_start_command") or DEFAULT_WORKER_START),
         idle_timeout_s=float(raw.get("idle_timeout_s", 60.0)),
         env=env,
+        gcp=gcp_cfg,
     )

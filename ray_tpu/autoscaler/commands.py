@@ -127,7 +127,7 @@ def up(spec_path: str, *, no_autoscaler: bool = False,
     save_cluster_state(spec, state)
 
     if not no_workers:
-        provider = RemoteNodeProvider(spec, address)
+        provider = provider_from_spec(spec, address)
         for t in spec.worker_types():
             for _ in range(t.min_workers):
                 pid = provider.create_node(t.name, dict(t.resources))
@@ -221,7 +221,7 @@ def down(spec_path: str) -> None:
     except Exception:
         pass
     if session_kill:
-        provider = RemoteNodeProvider(spec, address or "")
+        provider = provider_from_spec(spec, address or "")
         for host in provider.all_known_hosts():
             if host == spec.head_host:
                 continue
@@ -230,6 +230,20 @@ def down(spec_path: str) -> None:
                                             timeout=60.0, check=False)
             except Exception:
                 pass
+    if spec.provider_type == "gcp":
+        # Cloud capacity: terminate tracked nodes through the public
+        # provider API, then sweep by cluster label — autoscaler-
+        # launched nodes never reach the state file and would bill
+        # forever otherwise.
+        provider = provider_from_spec(spec, address or "")
+        if state.get("launched"):
+            provider.adopt(state["launched"])
+            for pid in list(state["launched"]):
+                provider.terminate_node(pid)
+        leaked = provider.cleanup_cluster_capacity()
+        if leaked:
+            logger.info("rt down: swept %d unrecorded TPU nodes: %s",
+                        len(leaked), leaked)
     try:
         os.remove(_state_path(spec.cluster_name))
     except OSError:
@@ -253,9 +267,18 @@ def exec_cluster(spec_path: str, cmd: str, *,
     return outs
 
 
+def provider_from_spec(spec: ClusterSpec,
+                       address: str) -> RemoteNodeProvider:
+    if spec.provider_type == "gcp":
+        from .gcp_provider import GCPTpuNodeProvider
+
+        return GCPTpuNodeProvider(spec, address)
+    return RemoteNodeProvider(spec, address)
+
+
 def autoscaler_from_spec(spec: ClusterSpec, address: str
                          ) -> StandardAutoscaler:
-    provider = RemoteNodeProvider(spec, address)
+    provider = provider_from_spec(spec, address)
     state = load_cluster_state(spec.cluster_name)
     if state and state.get("launched"):
         provider.adopt(state["launched"])

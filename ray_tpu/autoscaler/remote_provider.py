@@ -39,7 +39,7 @@ def make_runner(spec: ClusterSpec,
         return PodCommandRunner(
             [make_runner(spec, h) for h in host_or_hosts])
     host = host_or_hosts
-    if spec.provider_type == "subprocess":
+    if spec.runner_type() == "subprocess":
         return SubprocessCommandRunner(host)
     return SSHCommandRunner(host, user=spec.ssh_user,
                             key_file=spec.ssh_private_key,
@@ -125,6 +125,62 @@ class RemoteNodeProvider(NodeProvider):
             env=env or None, timeout=600.0)
         return _parse_trailer(out)
 
+    def _bootstrap_unit(self, node: "_LaunchedNode",
+                        t: NodeTypeSpec,
+                        resources: Dict[str, float]) -> None:
+        """Push setup + start the agent(s) on every host of the unit.
+        On slice-sibling failure, kills agents already started before
+        re-raising (subclasses decide what happens to the unit)."""
+        unit = node.unit
+        if isinstance(unit, list):                      # TPU slice
+            shares = split_slice_resources(
+                resources or t.resources, len(unit))
+
+            def _boot(i: int) -> Dict[str, str]:
+                return self._bootstrap_host(
+                    make_runner(self.spec, unit[i]), shares[i],
+                    extra_env={"RT_TPU_WORKER_ID": str(i),
+                               "RT_TPU_SLICE": node.provider_id},
+                    setup=t.setup_commands)
+
+            # All hosts of the slice bootstrap in parallel — the
+            # slice comes up in one host's time, not n hosts'.
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(len(unit)) as pool:
+                futs = [pool.submit(_boot, i)
+                        for i in range(len(unit))]
+                outs: List[Optional[Dict[str, str]]] = []
+                first_err: Optional[BaseException] = None
+                for f in futs:
+                    try:
+                        outs.append(f.result())
+                    except Exception as e:  # noqa: BLE001
+                        first_err = first_err or e
+                        outs.append(None)
+            for host, tr in zip(unit, outs):
+                if tr is None:
+                    continue
+                node.node_ids.append(tr.get("RT_NODE_ID", ""))
+                node.pids_by_host[host] = [
+                    int(x) for x in
+                    tr.get("RT_PIDS", "").split(",") if x]
+            if first_err is not None:
+                # A sibling host failed: agents already started on
+                # the hosts that succeeded would be orphaned when
+                # the unit is released — kill them.
+                self._kill_node_pids(node)
+                raise first_err
+        else:
+            runner = make_runner(self.spec, unit)
+            tr = self._bootstrap_host(runner,
+                                      resources or t.resources,
+                                      setup=t.setup_commands)
+            node.node_ids.append(tr.get("RT_NODE_ID", ""))
+            node.pids_by_host[unit] = [
+                int(x) for x in tr.get("RT_PIDS", "").split(",")
+                if x]
+
     def create_node(self, node_type: str,
                     resources: Dict[str, float]) -> str:
         t = self.spec.node_types[node_type]
@@ -136,54 +192,7 @@ class RemoteNodeProvider(NodeProvider):
         pid = f"{node_type}-{next(self._counter)}"
         node = _LaunchedNode(pid, node_type, unit)
         try:
-            if isinstance(unit, list):                      # TPU slice
-                shares = split_slice_resources(
-                    resources or t.resources, len(unit))
-
-                def _boot(i: int) -> Dict[str, str]:
-                    return self._bootstrap_host(
-                        make_runner(self.spec, unit[i]), shares[i],
-                        extra_env={"RT_TPU_WORKER_ID": str(i),
-                                   "RT_TPU_SLICE": pid},
-                        setup=t.setup_commands)
-
-                # All hosts of the slice bootstrap in parallel — the
-                # slice comes up in one host's time, not n hosts'.
-                from concurrent.futures import ThreadPoolExecutor
-
-                with ThreadPoolExecutor(len(unit)) as pool:
-                    futs = [pool.submit(_boot, i)
-                            for i in range(len(unit))]
-                    outs: List[Optional[Dict[str, str]]] = []
-                    first_err: Optional[BaseException] = None
-                    for f in futs:
-                        try:
-                            outs.append(f.result())
-                        except Exception as e:  # noqa: BLE001
-                            first_err = first_err or e
-                            outs.append(None)
-                for host, tr in zip(unit, outs):
-                    if tr is None:
-                        continue
-                    node.node_ids.append(tr.get("RT_NODE_ID", ""))
-                    node.pids_by_host[host] = [
-                        int(x) for x in
-                        tr.get("RT_PIDS", "").split(",") if x]
-                if first_err is not None:
-                    # A sibling host failed: agents already started on
-                    # the hosts that succeeded would be orphaned when
-                    # the unit returns to the free pool — kill them.
-                    self._kill_node_pids(node)
-                    raise first_err
-            else:
-                runner = make_runner(self.spec, unit)
-                tr = self._bootstrap_host(runner,
-                                          resources or t.resources,
-                                          setup=t.setup_commands)
-                node.node_ids.append(tr.get("RT_NODE_ID", ""))
-                node.pids_by_host[unit] = [
-                    int(x) for x in tr.get("RT_PIDS", "").split(",")
-                    if x]
+            self._bootstrap_unit(node, t, resources)
         except Exception:
             with self._lock:
                 self._free[node_type].insert(0, unit)

@@ -215,11 +215,13 @@ def destroy_collective_group(group_name: str = "default") -> None:
         from ray_tpu.core import runtime as _rt
 
         rt = _rt.get_runtime()
-        for prefix in (_DECL_PREFIX + group_name,
-                       f"col/{group_name}/"):
-            for key in rt.controller_call("kv_keys",
-                                          {"prefix": prefix}):
-                store.delete(key)
+        # Exact key for the declaration (a prefix scan would also hit
+        # 'train2' when destroying 'train'); the rank-address prefix
+        # ends with '/' so it is collision-safe.
+        store.delete(_DECL_PREFIX + group_name)
+        for key in rt.controller_call(
+                "kv_keys", {"prefix": f"col/{group_name}/"}):
+            store.delete(key)
     except Exception:
         logger.debug("KV cleanup for group %r failed", group_name,
                      exc_info=True)

@@ -156,10 +156,12 @@ class ClusterRuntime(BaseRuntime):
         self.current_lease_id: Optional[int] = None
         self.io.run(self._async_init())
         job_id = _job_id
+        self._registered_job_int: Optional[int] = None
         if job_id is None:
             r = self.io.run(self._ctl.call("register_job",
                                            {"driver": f"pid-{os.getpid()}"}))
             job_id = JobID.from_int(r["job_id"])
+            self._registered_job_int = r["job_id"]
         super().__init__(config, job_id)
         if not self.is_worker:
             self.io.spawn(self._event_poll_loop())
@@ -1440,6 +1442,16 @@ class ClusterRuntime(BaseRuntime):
     # ------------------------------------------------------------ shutdown
     def shutdown(self) -> None:
         self._shutdown_flag = True
+        if self._registered_job_int is not None and not self._owns_head:
+            # A departing driver finishes its job so the controller
+            # reaps its non-detached actors — a connect/disconnect
+            # driver must not leak workers into the shared cluster.
+            try:
+                self.io.run(self._ctl.call(
+                    "finish_job", {"job_id": self._registered_job_int}),
+                    timeout=10.0)
+            except Exception:
+                pass
         try:
             if self._owns_head:
                 try:

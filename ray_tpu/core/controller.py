@@ -778,7 +778,26 @@ class Controller:
         if job:
             job["alive"] = False
             self._mark_dirty()
-        return {"ok": True}
+        # Non-detached actors die with their job's driver (ref:
+        # gcs_actor_manager.cc OnJobFinished -> DestroyActor) — without
+        # this, every connect-and-disconnect driver leaks its actors'
+        # workers and their CPU leases into the shared cluster.
+        from .ids import JobID
+
+        jid = JobID.from_int(p["job_id"])
+        reaped = 0
+        for actor in list(self.actors.values()):
+            spec = actor.creation_spec
+            if actor.detached or spec is None or actor.state == DEAD:
+                continue
+            if spec.job_id == jid:
+                await self.kill_actor({"actor_id": actor.actor_id,
+                                       "no_restart": True})
+                reaped += 1
+        if reaped:
+            logger.info("job %s finished: reaped %d actors",
+                        p["job_id"], reaped)
+        return {"ok": True, "actors_reaped": reaped}
 
     # ------------------------------------------------------ placement groups
     async def create_placement_group(self, p):

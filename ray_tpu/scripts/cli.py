@@ -139,6 +139,33 @@ def cmd_start(args) -> int:
         custom_resources=resources, is_head=args.head,
         tag="head" if args.head else f"join-{os.getpid()}")
     pids.append(agent_proc.pid)
+
+    client_addr = None
+    if args.head and args.client_server_port >= 0:
+        # rt:// remote-driver listener (ref: Ray Client's default port
+        # 10001 on the head; util/client/server/proxier.py).
+        import subprocess as _sp
+
+        cs_proc = _sp.Popen(
+            [sys.executable, "-u", "-m", "ray_tpu.client.server",
+             "--address", ctl_addr,
+             "--port", str(args.client_server_port)],
+            stdout=_sp.PIPE, stderr=_sp.DEVNULL, text=True)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            line = cs_proc.stdout.readline()
+            if line.startswith("RT_CLIENT_SERVER_PORT="):
+                host = ctl_addr.rsplit(":", 1)[0]
+                client_addr = f"rt://{host}:{line.split('=')[1].strip()}"
+                break
+            if not line:
+                break
+        if client_addr is None:
+            print("warning: rt:// client server failed to start",
+                  file=sys.stderr)
+            cs_proc.terminate()
+        else:
+            pids.append(cs_proc.pid)
     _record(config, session, address=ctl_addr, pids=pids, head=args.head)
 
     if args.head:
@@ -149,7 +176,10 @@ def cmd_start(args) -> int:
               f"  python -m ray_tpu.scripts.cli start "
               f"--address={ctl_addr}\n\n"
               f"Connect a driver with:\n"
-              f"  ray_tpu.init(address=\"{ctl_addr}\")")
+              f"  ray_tpu.init(address=\"{ctl_addr}\")"
+              + (f"\n\nConnect a REMOTE driver (laptop) with:\n"
+                 f"  ray_tpu.init(address=\"{client_addr}\")"
+                 if client_addr else ""))
     else:
         print(f"Joined cluster at {ctl_addr}.\n"
               f"  node agent: {agent_addr} ({node_id[:12]})")
@@ -519,6 +549,10 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="session name override (head only)")
     sp.add_argument("--block", action="store_true",
                     help="stay in the foreground until the agent exits")
+    sp.add_argument("--client-server-port", type=int, default=-1,
+                    help="start an rt:// remote-driver listener on this"
+                         " port (0 = ephemeral; default: disabled; the"
+                         " reference's convention is 10001)")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("status", help="show cluster nodes and resources")

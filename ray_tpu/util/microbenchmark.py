@@ -109,16 +109,27 @@ def main() -> None:
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--record", action="store_true",
+                        help="append results to the PERF.jsonl "
+                             "regression ledger")
     args = parser.parse_args()
     owns = not ray_tpu.is_initialized()
     if owns:
         ray_tpu.init(mode="cluster", num_cpus=2)
     try:
-        for row in run(quick=args.quick):
+        results = run(quick=args.quick)
+        for row in results:
             print(json.dumps(row))
     finally:
         if owns:
             ray_tpu.shutdown()
+    if args.record:
+        from . import perf_ledger
+
+        source = "micro_quick" if args.quick else "micro"
+        perf_ledger.record(
+            [{"benchmark": r["benchmark"], "value": r["per_sec"],
+              "unit": "ops/s"} for r in results], source=source)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,97 @@
+"""Perf regression ledger — round-over-round benchmark records.
+
+Role-equivalent to the reference's release perf harness bookkeeping
+(ref: release/microbenchmark/run_microbenchmark.py writing results +
+release/release_tests.yaml defining pass criteria): every recorded
+benchmark run appends one JSON line per metric to ``PERF.jsonl`` at
+the repo root, and ``check_regressions`` compares the latest round's
+numbers against the best ever recorded — a >20% drop is a regression
+the test suite fails on (tests/test_perf_ledger.py).
+
+Record with:
+  python -m ray_tpu.util.microbenchmark --record [--quick]
+  python bench.py --record            (and --long-context --record)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+DEFAULT_LEDGER = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "PERF.jsonl")
+
+
+def record(entries: List[Dict[str, Any]], *, source: str,
+           path: Optional[str] = None,
+           round_tag: Optional[str] = None) -> None:
+    """Append one line per metric: {ts, round, source, benchmark,
+    value, unit, higher_is_better}."""
+    path = path or DEFAULT_LEDGER
+    ts = time.time()
+    tag = round_tag or os.environ.get("RT_PERF_ROUND", "")
+    with open(path, "a") as f:
+        for e in entries:
+            row = {"ts": ts, "round": tag, "source": source,
+                   "benchmark": e["benchmark"],
+                   "value": float(e["value"]),
+                   "unit": e.get("unit", ""),
+                   "higher_is_better":
+                       bool(e.get("higher_is_better", True))}
+            f.write(json.dumps(row) + "\n")
+
+
+def load(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    path = path or DEFAULT_LEDGER
+    rows: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+    except OSError:
+        pass
+    return rows
+
+
+def check_regressions(path: Optional[str] = None, *,
+                      threshold: float = 0.20,
+                      source: Optional[str] = None) -> List[str]:
+    """Compare each metric's LATEST record against its best earlier
+    record; returns human-readable regression descriptions (empty =
+    healthy).  Only metrics with >=2 records are judged — a metric's
+    first record IS its baseline."""
+    rows = load(path)
+    if source is not None:
+        rows = [r for r in rows if r["source"] == source]
+    by_metric: Dict[str, List[Dict[str, Any]]] = {}
+    for r in rows:
+        by_metric.setdefault(
+            f'{r["source"]}/{r["benchmark"]}', []).append(r)
+    problems: List[str] = []
+    for name, recs in by_metric.items():
+        if len(recs) < 2:
+            continue
+        recs.sort(key=lambda r: r["ts"])
+        latest = recs[-1]
+        earlier = recs[:-1]
+        hib = latest.get("higher_is_better", True)
+        if hib:
+            best = max(e["value"] for e in earlier)
+            if best > 0 and latest["value"] < best * (1 - threshold):
+                problems.append(
+                    f"{name}: {latest['value']:g} is "
+                    f"{100 * (1 - latest['value'] / best):.0f}% below "
+                    f"best {best:g}")
+        else:
+            best = min(e["value"] for e in earlier)
+            if best > 0 and latest["value"] > best * (1 + threshold):
+                problems.append(
+                    f"{name}: {latest['value']:g} is "
+                    f"{100 * (latest['value'] / best - 1):.0f}% above "
+                    f"best {best:g}")
+    return problems

@@ -1,0 +1,221 @@
+"""rt:// remote driver: the full cluster-mode semantic spec must pass
+unchanged through one client connection.
+
+Ref: python/ray/util/client/ARCHITECTURE.md (one connection, server-
+side SpecificServer per client) — round-3 VERDICT item 4: previously
+every driver needed a cluster-routable agent.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.client import ClientServer
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.rpc import EventLoopThread
+
+
+@pytest.fixture(scope="module")
+def rt_address():
+    """A real cluster + a ClientServer relay in this process; yields
+    the rt:// address thin clients dial."""
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 8})
+    io = EventLoopThread("client-server")
+    server = ClientServer(cluster.address, host="127.0.0.1")
+    io.run(server.start())
+    yield f"rt://127.0.0.1:{server.port}"
+    io.run(server.stop())
+    cluster.shutdown()
+
+
+def test_cluster_mode_suite_through_client(rt_address):
+    """Run tests/test_cluster_mode.py VERBATIM as a thin client: the
+    module's fixture switches to init(address='rt://...') when
+    RT_TEST_CLIENT_ADDRESS is set.  Every task/actor/object semantic
+    must hold over the single-connection protocol."""
+    env = {**os.environ, "RT_TEST_CLIENT_ADDRESS": rt_address}
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-x", "-q",
+         os.path.join(os.path.dirname(__file__),
+                      "test_cluster_mode.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout[-4000:]
+
+
+def test_two_clients_are_isolated_drivers(rt_address):
+    """Each client connection gets its OWN session-host driver (job):
+    named actors created by one are visible to the other (cluster
+    scope), but object refs are per-driver and do not collide."""
+    script = r"""
+import sys
+import numpy as np
+import ray_tpu
+
+addr, role = sys.argv[1], sys.argv[2]
+ray_tpu.init(address=addr)
+
+@ray_tpu.remote
+def who(x):
+    return x * 2
+
+refs = [who.remote(i) for i in range(8)]
+assert ray_tpu.get(refs, timeout=120) == [2 * i for i in range(8)]
+
+class Counter:
+    def __init__(self):
+        self.n = 0
+    def bump(self):
+        self.n += 1
+        return self.n
+
+if role == "creator":
+    c = ray_tpu.remote(Counter).options(
+        name="shared_counter", num_cpus=0).remote()
+    assert ray_tpu.get(c.bump.remote(), timeout=60) == 1
+    print("CREATOR_OK", flush=True)
+    import time
+    time.sleep(20)   # stay alive while the peer uses the actor
+else:
+    import time
+    deadline = time.time() + 30
+    c = None
+    while time.time() < deadline:
+        try:
+            c = ray_tpu.get_actor("shared_counter")
+            break
+        except ValueError:
+            time.sleep(0.5)
+    assert c is not None, "named actor never appeared across clients"
+    assert ray_tpu.get(c.bump.remote(), timeout=60) >= 2
+    print("PEER_OK", flush=True)
+ray_tpu.shutdown()
+"""
+    addr = rt_address[len("rt://"):]
+    p1 = subprocess.Popen(
+        [sys.executable, "-c", script, f"rt://{addr}", "creator"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    # Wait for the creator to own the named actor before the peer dials.
+    out1_lines = []
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        line = p1.stdout.readline()
+        out1_lines.append(line)
+        if "CREATOR_OK" in line or not line:
+            break
+    assert any("CREATOR_OK" in ln for ln in out1_lines), \
+        "".join(out1_lines)[-3000:]
+    p2 = subprocess.run(
+        [sys.executable, "-c", script, f"rt://{addr}", "peer"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=180)
+    assert p2.returncode == 0 and "PEER_OK" in p2.stdout, \
+        p2.stdout[-3000:]
+    p1.wait(timeout=120)
+
+
+def test_client_error_propagation_and_timeout(rt_address):
+    script = r"""
+import sys
+import ray_tpu
+ray_tpu.init(address=sys.argv[1])
+
+@ray_tpu.remote
+def boom():
+    raise ValueError("client-visible failure")
+
+try:
+    ray_tpu.get(boom.remote(), timeout=120)
+    raise SystemExit("no error raised")
+except ValueError as e:
+    assert "client-visible failure" in str(e)
+    assert "Remote traceback" in str(e), str(e)[:500]
+
+@ray_tpu.remote
+def slow():
+    import time
+    time.sleep(30)
+
+from ray_tpu import GetTimeoutError
+try:
+    ray_tpu.get(slow.remote(), timeout=1.0)
+    raise SystemExit("no timeout raised")
+except GetTimeoutError:
+    pass
+print("ERRORS_OK", flush=True)
+ray_tpu.shutdown()
+"""
+    p = subprocess.run([sys.executable, "-c", script, rt_address],
+                       stdout=subprocess.PIPE,
+                       stderr=subprocess.STDOUT, text=True,
+                       timeout=300)
+    assert p.returncode == 0 and "ERRORS_OK" in p.stdout, \
+        p.stdout[-3000:]
+
+
+def test_disconnecting_driver_reaps_its_actors(rt_address):
+    """Job-finish actor cleanup (the bug the client surfaced): ANY
+    connect-and-disconnect driver must not leak its non-detached
+    actors' workers/leases into the shared cluster (ref:
+    gcs_actor_manager.cc OnJobFinished -> DestroyActor)."""
+    script = r"""
+import sys
+import ray_tpu
+ray_tpu.init(address=sys.argv[1])
+
+class Holder:
+    def pid(self):
+        import os
+        return os.getpid()
+
+actors = [ray_tpu.remote(Holder).remote() for _ in range(3)]
+pids = ray_tpu.get([a.pid.remote() for a in actors], timeout=120)
+assert len(set(pids)) == 3
+print("HOLDING", flush=True)
+ray_tpu.shutdown()
+"""
+    import re as _re
+
+    addr = rt_address  # thin-client driver
+    script2 = (
+        "import sys, ray_tpu; ray_tpu.init(address=sys.argv[1]); "
+        "print('AVAIL', ray_tpu.available_resources().get('CPU', 0)); "
+        "ray_tpu.shutdown()")
+
+    def _avail() -> float:
+        q = subprocess.run([sys.executable, "-c", script2, addr],
+                           stdout=subprocess.PIPE,
+                           stderr=subprocess.STDOUT, text=True,
+                           timeout=120)
+        m = _re.search(r"AVAIL ([\d.]+)", q.stdout)
+        assert m, q.stdout[-1500:]
+        return float(m.group(1))
+
+    # Baseline BEFORE the holder driver (earlier module tests may
+    # legitimately hold capacity); recovery is judged against it.
+    deadline = time.time() + 60
+    baseline = 0.0
+    while time.time() < deadline and baseline < 3.0:
+        baseline = _avail()
+        time.sleep(0.5)
+    assert baseline >= 3.0, f"cluster too busy to test: {baseline}"
+    p = subprocess.run([sys.executable, "-c", script, addr],
+                       stdout=subprocess.PIPE,
+                       stderr=subprocess.STDOUT, text=True,
+                       timeout=180)
+    assert p.returncode == 0 and "HOLDING" in p.stdout, \
+        p.stdout[-2000:]
+    # After the driver leaves, its 3 actor leases must come back.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if _avail() >= baseline:
+            return
+        time.sleep(1.0)
+    raise AssertionError(
+        f"actor leases never returned to baseline {baseline}")

@@ -17,7 +17,14 @@ import ray_tpu
 
 @pytest.fixture(scope="module", autouse=True)
 def _rt():
-    rt = ray_tpu.init(mode="cluster", num_cpus=8)
+    # RT_TEST_CLIENT_ADDRESS reruns this WHOLE module through a thin
+    # rt:// remote driver (see test_client_mode.py) — the semantic spec
+    # must hold unchanged over the client protocol.
+    addr = os.environ.get("RT_TEST_CLIENT_ADDRESS")
+    if addr:
+        rt = ray_tpu.init(address=addr)
+    else:
+        rt = ray_tpu.init(mode="cluster", num_cpus=8)
     yield rt
     ray_tpu.shutdown()
 

@@ -1,0 +1,81 @@
+"""Perf regression ledger: record/load/compare mechanics + the repo
+guard that fails when a recorded metric regresses >20% vs its best.
+
+Ref: release/microbenchmark/run_microbenchmark.py + release_tests.yaml
+pass criteria — round-3 VERDICT item 8: micro/bench numbers were never
+recorded or compared round-over-round.
+"""
+
+import json
+import os
+
+from ray_tpu.util import perf_ledger
+
+
+def _write(path, rows):
+    with open(path, "a") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_record_and_load(tmp_path):
+    path = str(tmp_path / "PERF.jsonl")
+    perf_ledger.record(
+        [{"benchmark": "a", "value": 100.0, "unit": "ops/s"},
+         {"benchmark": "b", "value": 5.0, "unit": "s",
+          "higher_is_better": False}],
+        source="test", path=path, round_tag="r1")
+    rows = perf_ledger.load(path)
+    assert len(rows) == 2
+    assert rows[0]["round"] == "r1"
+    assert rows[1]["higher_is_better"] is False
+
+
+def test_regression_detected_higher_is_better(tmp_path):
+    path = str(tmp_path / "PERF.jsonl")
+    _write(path, [
+        {"ts": 1, "source": "m", "benchmark": "tput", "value": 100.0,
+         "higher_is_better": True},
+        {"ts": 2, "source": "m", "benchmark": "tput", "value": 79.0,
+         "higher_is_better": True},
+    ])
+    problems = perf_ledger.check_regressions(path)
+    assert len(problems) == 1 and "tput" in problems[0]
+    # Within threshold: healthy.
+    _write(path, [{"ts": 3, "source": "m", "benchmark": "tput",
+                   "value": 85.0, "higher_is_better": True}])
+    assert perf_ledger.check_regressions(path) == []
+
+
+def test_regression_detected_lower_is_better(tmp_path):
+    path = str(tmp_path / "PERF.jsonl")
+    _write(path, [
+        {"ts": 1, "source": "m", "benchmark": "lat", "value": 1.0,
+         "higher_is_better": False},
+        {"ts": 2, "source": "m", "benchmark": "lat", "value": 1.5,
+         "higher_is_better": False},
+    ])
+    assert len(perf_ledger.check_regressions(path)) == 1
+
+
+def test_single_record_is_baseline_not_regression(tmp_path):
+    path = str(tmp_path / "PERF.jsonl")
+    _write(path, [{"ts": 1, "source": "m", "benchmark": "x",
+                   "value": 1.0, "higher_is_better": True}])
+    assert perf_ledger.check_regressions(path) == []
+
+
+def test_repo_ledger_has_no_regressions():
+    """THE guard: every metric's latest recorded round must be within
+    20% of its best.  Rounds append via `--record`; a regression lands
+    here as a test failure the next run."""
+    problems = perf_ledger.check_regressions()
+    assert problems == [], "\n".join(problems)
+
+
+def test_repo_ledger_has_entries():
+    """The ledger must actually carry this round's records (round-3
+    'done' bar: ledger has round-4 entries)."""
+    rows = perf_ledger.load()
+    assert rows, ("PERF.jsonl is empty — record with "
+                  "`python -m ray_tpu.util.microbenchmark --record`")
