@@ -417,8 +417,15 @@ class NodeAgent:
             log_dir, f"worker-{self.node_id.hex()[:8]}-"
             f"{self._starting_workers}-{time.time():.0f}.log")
         out = open(log_path, "ab")
+        # pip envs: spawn the trampoline, which builds/reuses the venv
+        # (file-locked, off this event loop) and execs worker_main
+        # under the venv python (ref: _private/runtime_env/pip.py —
+        # the worker STARTS inside its environment).
+        module = ("ray_tpu.runtime_env.pip_bootstrap"
+                  if runtime_env and runtime_env.get("pip")
+                  else "ray_tpu.core.worker_main")
         proc = subprocess.Popen(
-            [sys.executable, "-u", "-m", "ray_tpu.core.worker_main"],
+            [sys.executable, "-u", "-m", module],
             env=env, stdout=out, stderr=subprocess.STDOUT,
             start_new_session=True)
         out.close()

@@ -16,6 +16,9 @@ Supported fields:
                   reference).
 - ``py_modules``: list of local package directories, each importable in
                   the worker.
+- ``pip``:        requirement list (or {"packages": [...]}); the worker
+                  process starts inside a hash-keyed cached virtualenv
+                  with those requirements installed (pip.py).
 
 Workers are cached per environment hash: tasks with the same runtime env
 reuse warm workers; a different env gets a fresh process (ref:
@@ -41,7 +44,7 @@ def normalize(runtime_env: Optional[Dict[str, Any]]
     """Validate + canonicalize a user-supplied runtime_env dict."""
     if not runtime_env:
         return None
-    allowed = {"env_vars", "working_dir", "py_modules"}
+    allowed = {"env_vars", "working_dir", "py_modules", "pip"}
     unknown = set(runtime_env) - allowed
     if unknown:
         raise ValueError(
@@ -65,6 +68,10 @@ def normalize(runtime_env: Optional[Dict[str, Any]]
         if not os.path.isdir(wd):
             raise ValueError(f"working_dir {wd!r} is not a directory")
         out["working_dir"] = wd
+    if runtime_env.get("pip"):
+        from .pip import normalize_pip
+
+        out["pip"] = normalize_pip(runtime_env["pip"])
     mods = runtime_env.get("py_modules") or []
     if mods:
         norm = []
@@ -120,6 +127,10 @@ def package(env: Dict[str, Any]
     spec: Dict[str, Any] = {}
     if env.get("env_vars"):
         spec["env_vars"] = env["env_vars"]
+    if env.get("pip"):
+        # Requirements travel in the spec (tiny); the venv builds on
+        # each node at first use, cached by requirement hash.
+        spec["pip"] = list(env["pip"])
     if env.get("working_dir"):
         spec["working_dir_pkg"] = pack(env["working_dir"])
     if env.get("py_modules"):

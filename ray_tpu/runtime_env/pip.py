@@ -1,0 +1,136 @@
+"""pip/virtualenv runtime-env plugin.
+
+Role-equivalent to the reference's pip plugin (ref:
+python/ray/_private/runtime_env/pip.py — hash-keyed cached virtualenv
+per requirement set, workers run inside it; uv.py is the same shape).
+TPU adaptation: venvs are created with ``--system-site-packages`` so
+the heavyweight cluster stack (jax/libtpu/flax) is inherited, and only
+the env's extra requirements install into the venv.
+
+The worker STARTS inside the env: the node agent spawns
+``python -m ray_tpu.runtime_env.pip_bootstrap`` (cluster python),
+which builds-or-reuses the venv under a file lock and then execs the
+venv's python as ``ray_tpu.core.worker_main`` — the agent's event loop
+never blocks on a pip install, and concurrent workers of the same env
+share one build (ref: pip.py's per-URI lock + worker startup hook).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+_OK_MARKER = ".rt_venv_ok"
+
+
+def normalize_pip(value) -> List[str]:
+    """Accept ``["pkg==1.0", ...]`` or ``{"packages": [...]}`` (the
+    reference's two spellings).  ORDER IS PRESERVED: entries may be
+    pip flags whose value is the next entry (``["--index-url", URL,
+    "pkg"]``) — sorting would orphan them."""
+    if isinstance(value, dict):
+        value = value.get("packages", [])
+    if not isinstance(value, (list, tuple)) or not all(
+            isinstance(x, str) for x in value):
+        raise TypeError(
+            "runtime_env['pip'] must be a list of requirement strings "
+            "or {'packages': [...]}")
+    return list(value)
+
+
+def venv_key(packages: List[str]) -> str:
+    """Cache key: requirements + interpreter version (a venv built for
+    one python minor version is not valid for another)."""
+    payload = json.dumps(
+        {"reqs": list(packages),
+         "py": sys.version_info[:2]}, sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def _venv_python(venv_dir: str) -> str:
+    return os.path.join(venv_dir, "bin", "python")
+
+
+def ensure_venv(packages: List[str], cache_root: str,
+                log=None) -> str:
+    """Build (or reuse) the venv for ``packages``; returns its python
+    executable path.  Safe under concurrent callers via flock."""
+    import fcntl
+
+    packages = normalize_pip(packages)
+    key = venv_key(packages)
+    os.makedirs(cache_root, exist_ok=True)
+    venv_dir = os.path.join(cache_root, f"venv-{key}")
+    marker = os.path.join(venv_dir, _OK_MARKER)
+    if os.path.exists(marker):
+        return _venv_python(venv_dir)
+    lock_path = os.path.join(cache_root, f"venv-{key}.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if os.path.exists(marker):   # another worker built it
+            return _venv_python(venv_dir)
+        if log:
+            log(f"building pip venv {key} for {packages}")
+        tmp = f"{venv_dir}.tmp.{os.getpid()}"
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages",
+             tmp], check=True, capture_output=True)
+        # --system-site-packages resolves to the BASE prefix; when the
+        # cluster python is itself a venv (common), its site-packages
+        # (jax/libtpu/setuptools) would be invisible — link them in via
+        # a .pth.  The venv's own installs still shadow them (its
+        # site-packages sorts first).
+        import glob as _glob
+
+        venv_site = _glob.glob(os.path.join(
+            tmp, "lib", "python*", "site-packages"))[0]
+        parent_sites = [p for p in sys.path
+                        if p.endswith("site-packages")
+                        and os.path.isdir(p)]
+        if parent_sites:
+            with open(os.path.join(venv_site,
+                                   "_rt_parent_site.pth"), "w") as f:
+                f.write("\n".join(parent_sites) + "\n")
+        # The list passes to pip IN ORDER (flags keep their values);
+        # install only when something beyond bare flags is present.
+        if any(not x.startswith("-") for x in packages):
+            proc = subprocess.run(
+                [_venv_python(tmp), "-m", "pip", "install",
+                 "--disable-pip-version-check", *packages],
+                capture_output=True, text=True)
+            if proc.returncode != 0:
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise RuntimeError(
+                    f"pip install failed for {packages}:\n"
+                    f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+        if os.path.isdir(venv_dir):  # stale partial build (no marker)
+            import shutil
+
+            shutil.rmtree(venv_dir, ignore_errors=True)
+        os.replace(tmp, venv_dir)
+        with open(marker, "w") as f:
+            f.write("\n".join(packages))
+        return _venv_python(venv_dir)
+
+
+def bootstrap_main() -> int:
+    """Entry for ``python -m ray_tpu.runtime_env.pip_bootstrap``: the
+    agent-spawned trampoline that lands the worker inside its venv."""
+    spec = json.loads(os.environ.get("RT_RUNTIME_ENV", "{}"))
+    packages = spec.get("pip") or []
+    from ray_tpu.core.config import RuntimeConfig
+
+    cfg = RuntimeConfig.from_env()
+    cache_root = os.path.join(
+        cfg.session_dir_root,
+        os.environ.get("RT_SESSION_NAME", "default"), "pip_envs")
+    python = ensure_venv(packages, cache_root,
+                         log=lambda m: print(m, flush=True))
+    os.execv(python, [python, "-u", "-m", "ray_tpu.core.worker_main"])
+    return 0  # unreachable

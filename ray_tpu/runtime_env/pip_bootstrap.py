@@ -1,0 +1,9 @@
+"""Trampoline: build/reuse the env's venv, then exec worker_main inside
+it (see pip.py; ref: _private/runtime_env/pip.py worker startup)."""
+
+import sys
+
+from .pip import bootstrap_main
+
+if __name__ == "__main__":
+    sys.exit(bootstrap_main())

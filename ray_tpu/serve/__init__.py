@@ -117,6 +117,23 @@ def start_http_proxies(port: int = 0) -> Dict[str, int]:
     return out
 
 
+_grpc_proxy = None
+
+
+def start_grpc_proxy(port: int = 0) -> int:
+    """Start (or reuse) the gRPC ingress on THIS node; returns the
+    bound port (ref: serve/_private/proxy.py:540 gRPCProxy)."""
+    global _grpc_proxy
+    from .grpc_proxy import GRPCProxy
+
+    if _grpc_proxy is None:
+        cls = ray_tpu.remote(GRPCProxy)
+        _grpc_proxy = cls.options(max_concurrency=32, num_cpus=0,
+                                  name="rt_serve_grpc_proxy",
+                                  get_if_exists=True).remote(port)
+    return ray_tpu.get(_grpc_proxy.port.remote())
+
+
 def get_deployment_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name)
 
@@ -137,7 +154,7 @@ def delete(deployment_name: str) -> None:
 
 
 def shutdown() -> None:
-    global _http_proxy
+    global _http_proxy, _grpc_proxy
     try:
         ctl = ray_tpu.get_actor(CONTROLLER_NAME)
         for name in list(ray_tpu.get(ctl.list_deployments.remote())):
@@ -145,9 +162,11 @@ def shutdown() -> None:
         ray_tpu.kill(ctl)
     except ValueError:
         pass
-    if _http_proxy is not None:
-        try:
-            ray_tpu.kill(_http_proxy)
-        except Exception:
-            pass
-        _http_proxy = None
+    for proxy in (_http_proxy, _grpc_proxy):
+        if proxy is not None:
+            try:
+                ray_tpu.kill(proxy)
+            except Exception:
+                pass
+    _http_proxy = None
+    _grpc_proxy = None

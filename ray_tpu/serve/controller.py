@@ -26,8 +26,10 @@ class _Replica:
 
     def __init__(self, cls_payload: bytes, init_args: tuple,
                  init_kwargs: dict, is_function: bool):
-        import cloudpickle
+        import asyncio
         import threading
+
+        import cloudpickle
 
         target = cloudpickle.loads(cls_payload)
         self._is_function = is_function
@@ -36,12 +38,34 @@ class _Replica:
         # of relying on CPython's GIL making `+= 1` atomic-enough.
         self._ongoing_lock = threading.Lock()
         self._ongoing = 0
+        # DEDICATED event loop for async handlers (ref:
+        # serve/_private/replica.py runs its own loop): method threads
+        # submit coroutines here instead of juggling whatever loop the
+        # actor thread happens to have — awaiting actor calls inside an
+        # async handler deadlocked the old run_until_complete path.
+        self._loop = asyncio.new_event_loop()
+        threading.Thread(target=self._run_loop, daemon=True,
+                         name="replica-loop").start()
+        # Live response streams: stream id -> (a)sync generator.
+        self._streams: Dict[str, Any] = {}
         if is_function:
             self._fn = target
             self._instance = None
         else:
             self._instance = target(*init_args, **init_kwargs)
             self._fn = None
+
+    def _run_loop(self) -> None:
+        import asyncio
+
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_forever()
+
+    def _await(self, coro):
+        import asyncio
+
+        return asyncio.run_coroutine_threadsafe(
+            coro, self._loop).result()
 
     def _enter(self) -> None:
         with self._ongoing_lock:
@@ -51,29 +75,126 @@ class _Replica:
         with self._ongoing_lock:
             self._ongoing -= 1
 
-    def handle_request(self, args: tuple, kwargs: dict):
-        import asyncio
+    def _finish(self, result):
+        """Await coroutines on the replica loop; register generator
+        results as streams and hand back a marker the caller pulls
+        chunks with (ref: proxy.py:763 streaming responses +
+        replica.py result generators)."""
         import inspect
+        import uuid
 
+        if inspect.iscoroutine(result):
+            result = self._await(result)
+        if inspect.isgenerator(result) or inspect.isasyncgen(result):
+            sid = uuid.uuid4().hex[:16]
+            # A live stream IS an ongoing request: autoscale drain
+            # must not kill this replica between chunk pulls.  The
+            # matching _exit happens when the stream completes, errors,
+            # or is reaped.
+            self._enter()
+            self._streams[sid] = [result, time.time()]
+            marker = {"__rt_stream__": sid}
+            aid = ray_tpu.get_runtime_context().get_actor_id()
+            if aid:
+                marker["replica"] = aid
+            return marker
+        return result
+
+    def handle_request(self, args: tuple, kwargs: dict):
         self._enter()
         try:
             target = self._fn if self._is_function else self._instance
-            result = target(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                result = asyncio.get_event_loop().run_until_complete(
-                    result) if not asyncio.get_event_loop().is_running() \
-                    else asyncio.run_coroutine_threadsafe(
-                        result, asyncio.get_event_loop()).result()
-            return result
+            return self._finish(target(*args, **kwargs))
         finally:
             self._exit()
 
     def call_method(self, method: str, args: tuple, kwargs: dict):
         self._enter()
         try:
-            return getattr(self._instance, method)(*args, **kwargs)
+            return self._finish(
+                getattr(self._instance, method)(*args, **kwargs))
         finally:
             self._exit()
+
+    _STREAM_IDLE_TTL_S = 300.0   # reap streams nobody pulls from
+    _BATCH_WINDOW_S = 0.2        # batch items, never delay first byte
+
+    def _close_stream(self, sid: str) -> None:
+        entry = self._streams.pop(sid, None)
+        if entry is None:
+            return
+        import inspect
+
+        it = entry[0]
+        try:
+            if inspect.isasyncgen(it):
+                self._await(it.aclose())
+            else:
+                it.close()
+        except Exception:
+            pass
+        self._exit()   # balances the _enter at registration
+
+    def cancel_stream(self, sid: str) -> None:
+        self._close_stream(sid)
+
+    def open_streams(self) -> int:
+        return len(self._streams)
+
+    def _reap_stale_streams(self) -> None:
+        now = time.time()
+        for sid, (_it, last) in list(self._streams.items()):
+            if now - last > self._STREAM_IDLE_TTL_S:
+                self._close_stream(sid)
+
+    def next_chunks(self, sid: str, max_items: int = 64):
+        """Pull from a registered stream: blocks for the FIRST item,
+        then batches whatever more arrives within a short window — a
+        slow producer streams incrementally (one item per call), a
+        fast one amortizes RPCs (ref: proxy.py:763 streaming —
+        first-byte latency is the contract).  Generator errors tear
+        the stream down and surface to the caller."""
+        import inspect
+
+        self._reap_stale_streams()
+        entry = self._streams.get(sid)
+        if entry is None:
+            return {"items": [], "done": True}
+        it = entry[0]
+        entry[1] = time.time()
+        items: List[Any] = []
+        done = False
+        deadline = time.time() + self._BATCH_WINDOW_S
+        try:
+            if inspect.isasyncgen(it):
+                async def pull():
+                    out: List[Any] = []
+                    try:
+                        while len(out) < max_items:
+                            out.append(await it.__anext__())
+                            if time.time() > deadline:
+                                break
+                    except StopAsyncIteration:
+                        return out, True
+                    return out, False
+
+                items, done = self._await(pull())
+            else:
+                try:
+                    while len(items) < max_items:
+                        items.append(next(it))
+                        if time.time() > deadline:
+                            break
+                except StopIteration:
+                    done = True
+        except Exception as e:  # noqa: BLE001 — user generator raised
+            self._close_stream(sid)
+            return {"items": items, "done": True,
+                    "error": repr(e)}
+        if done:
+            self._streams.pop(sid, None)
+            self._exit()
+        return {"items": items, "done": done}
 
     def ongoing(self) -> int:
         return self._ongoing
@@ -482,6 +603,45 @@ class DeploymentHandle:
         replica, key = self._pick()
         return self._track(replica.handle_request.remote(args, kwargs),
                            key)
+
+    def replica_by_key(self, key: str):
+        """Resolve a replica handle by actor-id hex (stream affinity:
+        chunks must pull from the replica that holds the generator)."""
+        with self._lock:
+            for rep in self._replicas:
+                if rep.actor_id.hex() == key:
+                    return rep
+        return None
+
+    def stream(self, *args, **kwargs):
+        """Call a generator deployment; yields response items as the
+        replica produces them (ref: handle streaming via
+        handle.options(stream=True) in the reference)."""
+        replica, key = self._pick()
+        first = ray_tpu.get(self._track(
+            replica.handle_request.remote(args, kwargs), key),
+            timeout=120)
+        if not (isinstance(first, dict) and "__rt_stream__" in first):
+            yield first   # non-generator handler: one item
+            return
+        sid = first["__rt_stream__"]
+        try:
+            while True:
+                r = ray_tpu.get(replica.next_chunks.remote(sid),
+                                timeout=120)
+                yield from r["items"]
+                if r.get("error"):
+                    raise RuntimeError(
+                        f"stream generator raised: {r['error']}")
+                if r["done"]:
+                    return
+        finally:
+            # Abandoned early (consumer broke out/errored): free the
+            # replica-side generator instead of waiting out the TTL.
+            try:
+                replica.cancel_stream.remote(sid)
+            except Exception:
+                pass
 
     def method(self, method_name: str):
         handle = self

@@ -1,0 +1,119 @@
+"""gRPC ingress proxy — generic service sharing the HTTP route table.
+
+Role-equivalent to the reference's gRPCProxy (ref:
+serve/_private/proxy.py:540 — a gRPC server whose methods resolve to
+deployments and whose responses may stream).  Without user-compiled
+stubs in the image, the surface is the generic-ingress pattern: one
+service ``ray_tpu.serve.Ingress`` with
+
+- ``Call``       (unary-unary):  request bytes = JSON
+  ``{"deployment": name}`` or ``{"route": "/prefix"}`` plus
+  ``"payload"``; response bytes = JSON ``{"result": ...}``.
+- ``CallStream`` (unary-stream): same request against a generator
+  deployment; each yielded item arrives as one JSON message.
+
+Routes come from the same controller long-poll the HTTP proxy uses, so
+both ingresses always agree on the table.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+SERVICE = "ray_tpu.serve.Ingress"
+
+
+class GRPCProxy:
+    """Actor: a grpc.server with generic handlers over deployments."""
+
+    def __init__(self, port: int = 0):
+        from concurrent import futures as _futures
+
+        import grpc
+
+        from .routes import RouteTable
+
+        self._handles: Dict[str, Any] = {}
+        self._route_table = RouteTable()
+
+        def _resolve(req: Dict[str, Any]) -> str:
+            if req.get("deployment"):
+                return req["deployment"]
+            route = req.get("route", "/")
+            target = self._route_table.resolve(route)
+            if target is None:
+                raise KeyError(f"no route for {route!r}")
+            return target
+
+        def _handle_for(name: str):
+            from .controller import DeploymentHandle
+
+            h = self._handles.get(name)
+            if h is None:
+                h = self._handles[name] = DeploymentHandle(name)
+            return h
+
+        def call(request: bytes, context) -> bytes:
+            import grpc as _grpc
+
+            import ray_tpu
+
+            try:
+                req = json.loads(request or b"{}")
+                handle = _handle_for(_resolve(req))
+                result = ray_tpu.get(handle.remote(req.get("payload")),
+                                     timeout=60)
+            except KeyError as e:
+                context.abort(_grpc.StatusCode.NOT_FOUND, str(e))
+            except Exception as e:  # noqa: BLE001 — surface to client
+                context.abort(_grpc.StatusCode.INTERNAL, repr(e))
+            if isinstance(result, dict) and "__rt_stream__" in result:
+                # Generator deployment called unary: free the
+                # replica-side stream and tell the client to use
+                # CallStream instead of leaking plumbing (abort raises,
+                # so it must run OUTSIDE the try above).
+                rep = handle.replica_by_key(result.get("replica", ""))
+                if rep is not None:
+                    try:
+                        rep.cancel_stream.remote(
+                            result["__rt_stream__"])
+                    except Exception:
+                        pass
+                context.abort(
+                    _grpc.StatusCode.INVALID_ARGUMENT,
+                    "deployment streams; use "
+                    "/ray_tpu.serve.Ingress/CallStream")
+            return json.dumps({"result": result}).encode()
+
+        def call_stream(request: bytes, context):
+            import grpc as _grpc
+
+            try:
+                req = json.loads(request or b"{}")
+                handle = _handle_for(_resolve(req))
+                for item in handle.stream(req.get("payload")):
+                    yield json.dumps(item).encode()
+            except KeyError as e:
+                context.abort(_grpc.StatusCode.NOT_FOUND, str(e))
+            except Exception as e:  # noqa: BLE001
+                context.abort(_grpc.StatusCode.INTERNAL, repr(e))
+
+        ident = lambda b: b  # noqa: E731 — raw-bytes (de)serializer
+        handlers = grpc.method_handlers_generic_handler(SERVICE, {
+            "Call": grpc.unary_unary_rpc_method_handler(
+                call, request_deserializer=ident,
+                response_serializer=ident),
+            "CallStream": grpc.unary_stream_rpc_method_handler(
+                call_stream, request_deserializer=ident,
+                response_serializer=ident),
+        })
+        self._server = grpc.server(
+            _futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((handlers,))
+        self._port = self._server.add_insecure_port(
+            f"0.0.0.0:{port}")
+        self._server.start()
+
+    def port(self) -> int:
+        return self._port

@@ -23,7 +23,10 @@ class HTTPProxy:
 
         from aiohttp import web
 
+        from .routes import RouteTable
+
         self._routes: Dict[str, Any] = {}
+        self._route_table = RouteTable()
         self._port = port
         self._actual_port = None
         self._ready = threading.Event()
@@ -33,13 +36,7 @@ class HTTPProxy:
             from .controller import DeploymentHandle
 
             path = "/" + request.match_info.get("tail", "")
-            target = None
-            target_prefix = ""
-            for prefix, name in self._route_table().items():
-                if path == prefix or path.startswith(
-                        prefix.rstrip("/") + "/"):
-                    if len(prefix) > len(target_prefix):
-                        target, target_prefix = name, prefix
+            target = self._route_table.resolve(path)
             if target is None:
                 return web.json_response(
                     {"error": f"no route for {path}"}, status=404)
@@ -58,6 +55,31 @@ class HTTPProxy:
                 None, lambda: handle.remote(payload))
             result = await loop.run_in_executor(
                 None, lambda: ray_tpu.get(ref, timeout=60))
+            if isinstance(result, dict) and "__rt_stream__" in result:
+                # Generator deployment: chunked response, one JSON
+                # line per yielded item, written as the replica
+                # produces them (ref: proxy.py:763 HTTPProxy
+                # streaming responses).
+                rep = handle.replica_by_key(result.get("replica", ""))
+                if rep is None:
+                    return web.json_response(
+                        {"error": "stream replica vanished"},
+                        status=500)
+                sid = result["__rt_stream__"]
+                resp = web.StreamResponse()
+                resp.content_type = "application/x-ndjson"
+                await resp.prepare(request)
+                while True:
+                    r = await loop.run_in_executor(
+                        None, lambda: ray_tpu.get(
+                            rep.next_chunks.remote(sid), timeout=60))
+                    for item in r["items"]:
+                        await resp.write(
+                            (json.dumps(item) + "\n").encode())
+                    if r["done"]:
+                        break
+                await resp.write_eof()
+                return resp
             if isinstance(result, (dict, list, str, int, float, bool,
                                    type(None))):
                 return web.json_response({"result": result})
@@ -79,50 +101,6 @@ class HTTPProxy:
         self._thread = threading.Thread(target=run_server, daemon=True)
         self._thread.start()
         self._ready.wait(30)
-
-    def _route_table(self) -> Dict[str, str]:
-        """Route table kept fresh by controller config PUSH: a daemon
-        thread parks in poll_update() and applies changes as they
-        happen (ref: long_poll.py — replaces the round-2 2 s TTL
-        poll)."""
-        if getattr(self, "_route_poller", None) is None or \
-                not self._route_poller.is_alive():
-            self._route_cache: Dict[str, str] = {}
-            self._route_version = -1
-            self._start_route_poller()
-        return self._route_cache
-
-    def _start_route_poller(self) -> None:
-        import ray_tpu
-        from .controller import CONTROLLER_NAME
-
-        # Synchronous first fetch so the first request routes.
-        try:
-            ctl = ray_tpu.get_actor(CONTROLLER_NAME)
-            r = ray_tpu.get(ctl.poll_update.remote(None, -1, 0.0),
-                            timeout=30)
-            self._route_cache = r["routes"]
-            self._route_version = r["version"]
-        except Exception:
-            pass
-
-        def loop():
-            import time as _t
-
-            import ray_tpu
-            while True:
-                try:
-                    ctl = ray_tpu.get_actor(CONTROLLER_NAME)
-                    r = ray_tpu.get(ctl.poll_update.remote(
-                        None, self._route_version, 25.0), timeout=40)
-                    self._route_cache = r["routes"]
-                    self._route_version = r["version"]
-                except Exception:
-                    _t.sleep(1.0)
-
-        self._route_poller = threading.Thread(
-            target=loop, daemon=True, name="serve-route-poll")
-        self._route_poller.start()
 
     def port(self) -> int:
         self._ready.wait(30)

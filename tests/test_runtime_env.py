@@ -90,14 +90,18 @@ def test_bad_runtime_env_raises_at_options():
     with pytest.raises(ValueError):
         f.options(runtime_env={"working_dir": "/nonexistent-dir-xyz"})
     with pytest.raises(ValueError):
-        f.options(runtime_env={"pip": ["requests"]})
+        f.options(runtime_env={"conda": "someenv"})  # unsupported key
+    with pytest.raises(TypeError):
+        f.options(runtime_env={"pip": "requests"})  # must be a list
 
 
 def test_runtime_env_validation():
     from ray_tpu import runtime_env as renv
 
-    with pytest.raises(ValueError):
-        renv.normalize({"pip": ["requests"]})
+    # Order preserved: entries may be flag/value pairs.
+    assert renv.normalize({"pip": ["b", "a"]}) == {"pip": ["b", "a"]}
+    assert renv.normalize(
+        {"pip": {"packages": ["x"]}}) == {"pip": ["x"]}
     with pytest.raises(TypeError):
         renv.normalize({"env_vars": {"A": 1}})
     assert renv.normalize(None) is None
@@ -105,3 +109,48 @@ def test_runtime_env_validation():
     spec, blobs = renv.package(
         renv.normalize({"env_vars": {"A": "1"}}) or {})
     assert spec["env_vars"] == {"A": "1"} and not blobs
+
+
+def test_pip_runtime_env_worker_in_venv(cluster_rt, tmp_path):
+    """A task with a pip requirement the cluster python LACKS runs
+    inside a hash-keyed cached virtualenv that has it (ref:
+    _private/runtime_env/pip.py; round-3 VERDICT item 7).  Hermetic:
+    the requirement is a local package installed with --no-index."""
+    pkg = tmp_path / "tinydep"
+    (pkg / "tinydep").mkdir(parents=True)
+    (pkg / "tinydep" / "__init__.py").write_text("VALUE = 42\n")
+    (pkg / "pyproject.toml").write_text(
+        '[build-system]\nrequires = ["setuptools"]\n'
+        'build-backend = "setuptools.build_meta"\n'
+        '[project]\nname = "tinydep"\nversion = "0.1.0"\n'
+        '[tool.setuptools]\npackages = ["tinydep"]\n')
+    reqs = ["--no-index", "--no-build-isolation", str(pkg)]
+
+    @ray_tpu.remote(runtime_env={"pip": reqs})
+    def use_dep():
+        import sys
+
+        import tinydep
+
+        return tinydep.VALUE, sys.executable
+
+    @ray_tpu.remote
+    def plain():
+        try:
+            import tinydep  # noqa: F401
+
+            return "unexpectedly importable"
+        except ImportError:
+            import sys
+
+            return sys.executable
+
+    value, venv_py = ray_tpu.get(use_dep.remote(), timeout=180)
+    assert value == 42
+    base_py = ray_tpu.get(plain.remote(), timeout=120)
+    assert venv_py != base_py, "worker did not start inside the venv"
+    assert "venv-" in venv_py
+    # Same env again: the cached venv is reused (fast path) and the
+    # worker pool serves a warm worker keyed by the env hash.
+    value2, venv_py2 = ray_tpu.get(use_dep.remote(), timeout=60)
+    assert (value2, venv_py2) == (42, venv_py)
