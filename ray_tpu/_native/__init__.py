@@ -21,26 +21,39 @@ _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 _lock = threading.Lock()
 
 
-def build_library(source: str, extra_flags=()) -> Optional[str]:
+def build_library(source: str, extra_flags=(),
+                  sanitize: Optional[str] = None) -> Optional[str]:
     """Compile ``src/<source>`` into a cached .so; returns its path or
-    None if no toolchain / compile failure."""
+    None if no toolchain / compile failure.
+
+    ``sanitize`` in {"address", "thread"} builds an instrumented
+    variant (cached separately; ref: .bazelrc:104-125 asan/tsan
+    configs).  Load it in a process started with
+    LD_PRELOAD=<libasan/libtsan> (see sanitizer_runtime()) — the
+    runtime must initialize before python does."""
     src_path = os.path.join(_SRC_DIR, source)
     try:
         with open(src_path, "rb") as f:
             digest = hashlib.sha256(f.read()).hexdigest()[:16]
     except OSError:
         return None
-    out = os.path.join(_BUILD_DIR,
-                       f"{os.path.splitext(source)[0]}-{digest}.so")
+    tag = f"-{sanitize}" if sanitize else ""
+    out = os.path.join(
+        _BUILD_DIR,
+        f"{os.path.splitext(source)[0]}-{digest}{tag}.so")
     if os.path.exists(out):
         return out
+    san_flags = []
+    if sanitize:
+        san_flags = [f"-fsanitize={sanitize}", "-g",
+                     "-fno-omit-frame-pointer", "-O1"]
     with _lock:
         if os.path.exists(out):
             return out
         os.makedirs(_BUILD_DIR, exist_ok=True)
         tmp = out + f".tmp{os.getpid()}"
         cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-               src_path, "-o", tmp, "-lpthread", "-lrt",
+               *san_flags, src_path, "-o", tmp, "-lpthread", "-lrt",
                *extra_flags]
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
@@ -55,3 +68,16 @@ def build_library(source: str, extra_flags=()) -> Optional[str]:
             return None
         os.replace(tmp, out)
         return out
+
+
+def sanitizer_runtime(sanitize: str) -> Optional[str]:
+    """Path of the sanitizer runtime to LD_PRELOAD (libasan/libtsan)."""
+    name = {"address": "libasan.so", "thread": "libtsan.so"}[sanitize]
+    try:
+        proc = subprocess.run(["g++", "-print-file-name=" + name],
+                              capture_output=True, text=True,
+                              timeout=30)
+    except OSError:
+        return None
+    path = proc.stdout.strip()
+    return path if path and os.path.sep in path else None

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import mmap as _mmap
+import os
 from multiprocessing import shared_memory
 from typing import Optional, Tuple
 
@@ -26,7 +27,12 @@ class ShmPool:
     def _load(cls):
         if cls._lib is not None:
             return cls._lib
-        path = build_library("shm_pool.cpp")
+        # RT_SHM_POOL_SANITIZE=address|thread loads an instrumented
+        # build (the test suite's sanitizer mode; the process must be
+        # started with the matching LD_PRELOAD runtime).
+        path = build_library(
+            "shm_pool.cpp",
+            sanitize=os.environ.get("RT_SHM_POOL_SANITIZE") or None)
         if path is None:
             raise RuntimeError("native shm_pool unavailable "
                                "(no toolchain or build failed)")

@@ -332,6 +332,18 @@ class Worker:
     # ---------------------------------------------------------- normal task
     async def push_task(self, p) -> TaskResult:
         spec: TaskSpec = p["spec"]
+        env_err = os.environ.get("RT_RUNTIME_ENV_ERROR")
+        if env_err:
+            # This worker's runtime env failed to build (e.g. pip
+            # install error); tasks fail FAST with the build error
+            # instead of the agent respawning bootstraps forever (ref:
+            # RuntimeEnvSetupError surfacing in runtime_env_agent).
+            from .errors import RuntimeEnvSetupError
+
+            return TaskResult(
+                task_id=spec.task_id, ok=False,
+                error=TaskError.from_exception(
+                    RuntimeEnvSetupError(env_err)))
         fn = self._load_func(spec)
         loop = asyncio.get_event_loop()
         return await loop.run_in_executor(
@@ -341,6 +353,17 @@ class Worker:
     # -------------------------------------------------------------- actors
     async def create_actor(self, p):
         spec: TaskSpec = p["spec"]
+        env_err = os.environ.get("RT_RUNTIME_ENV_ERROR")
+        if env_err:
+            from .errors import RuntimeEnvSetupError
+
+            await self._agent.call("report_actor_failure", {
+                "actor_id": spec.actor_id, "creation_failed": True,
+                "reason": f"runtime env setup failed: {env_err}"})
+            asyncio.get_event_loop().call_later(
+                0.2, self._exit_event.set)
+            return {"ok": False,
+                    "error": repr(RuntimeEnvSetupError(env_err))}
         chip_ids = p.get("chip_ids") or []
         if chip_ids:
             os.environ["TPU_VISIBLE_CHIPS"] = ",".join(map(str, chip_ids))

@@ -121,7 +121,11 @@ def ensure_venv(packages: List[str], cache_root: str,
 
 def bootstrap_main() -> int:
     """Entry for ``python -m ray_tpu.runtime_env.pip_bootstrap``: the
-    agent-spawned trampoline that lands the worker inside its venv."""
+    agent-spawned trampoline that lands the worker inside its venv.
+    A FAILED env build still execs a (base-python) worker, poisoned
+    via RT_RUNTIME_ENV_ERROR: it registers normally and fails its
+    tasks fast with RuntimeEnvSetupError — exiting here instead would
+    send the agent into an infinite respawn/reinstall loop."""
     spec = json.loads(os.environ.get("RT_RUNTIME_ENV", "{}"))
     packages = spec.get("pip") or []
     from ray_tpu.core.config import RuntimeConfig
@@ -130,7 +134,13 @@ def bootstrap_main() -> int:
     cache_root = os.path.join(
         cfg.session_dir_root,
         os.environ.get("RT_SESSION_NAME", "default"), "pip_envs")
-    python = ensure_venv(packages, cache_root,
-                         log=lambda m: print(m, flush=True))
+    try:
+        python = ensure_venv(packages, cache_root,
+                             log=lambda m: print(m, flush=True))
+    except Exception as e:  # noqa: BLE001 — poisoned worker reports it
+        print(f"pip env build failed: {e!r}", flush=True)
+        os.environ["RT_RUNTIME_ENV_ERROR"] = \
+            f"pip env build failed: {e}"[:2000]
+        python = sys.executable
     os.execv(python, [python, "-u", "-m", "ray_tpu.core.worker_main"])
     return 0  # unreachable

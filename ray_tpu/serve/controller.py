@@ -86,13 +86,25 @@ class _Replica:
         if inspect.iscoroutine(result):
             result = self._await(result)
         if inspect.isgenerator(result) or inspect.isasyncgen(result):
+            import concurrent.futures
+
             sid = uuid.uuid4().hex[:16]
             # A live stream IS an ongoing request: autoscale drain
             # must not kill this replica between chunk pulls.  The
             # matching _exit happens when the stream completes, errors,
             # or is reaped.
             self._enter()
-            self._streams[sid] = [result, time.time()]
+            # One single-thread executor per SYNC stream: a next()
+            # that outlives the batch window keeps running there and
+            # the next next_chunks call collects it — the RPC never
+            # blocks past its window on a slow producer.
+            pool = (None if inspect.isasyncgen(result) else
+                    concurrent.futures.ThreadPoolExecutor(
+                        max_workers=1,
+                        thread_name_prefix=f"stream-{sid}"))
+            self._streams[sid] = {
+                "it": result, "last": time.time(), "pool": pool,
+                "pending": None}
             marker = {"__rt_stream__": sid}
             aid = ray_tpu.get_runtime_context().get_actor_id()
             if aid:
@@ -101,6 +113,7 @@ class _Replica:
         return result
 
     def handle_request(self, args: tuple, kwargs: dict):
+        self._reap_stale_streams()  # reap even if nobody pulls chunks
         self._enter()
         try:
             target = self._fn if self._is_function else self._instance
@@ -125,14 +138,16 @@ class _Replica:
             return
         import inspect
 
-        it = entry[0]
+        it = entry["it"]
         try:
             if inspect.isasyncgen(it):
                 self._await(it.aclose())
             else:
                 it.close()
         except Exception:
-            pass
+            pass  # e.g. 'generator already executing' on a live pull
+        if entry["pool"] is not None:
+            entry["pool"].shutdown(wait=False)
         self._exit()   # balances the _enter at registration
 
     def cancel_stream(self, sid: str) -> None:
@@ -143,58 +158,78 @@ class _Replica:
 
     def _reap_stale_streams(self) -> None:
         now = time.time()
-        for sid, (_it, last) in list(self._streams.items()):
-            if now - last > self._STREAM_IDLE_TTL_S:
+        for sid, entry in list(self._streams.items()):
+            if now - entry["last"] > self._STREAM_IDLE_TTL_S:
                 self._close_stream(sid)
 
-    def next_chunks(self, sid: str, max_items: int = 64):
-        """Pull from a registered stream: blocks for the FIRST item,
-        then batches whatever more arrives within a short window — a
-        slow producer streams incrementally (one item per call), a
-        fast one amortizes RPCs (ref: proxy.py:763 streaming —
-        first-byte latency is the contract).  Generator errors tear
-        the stream down and surface to the caller."""
+    def _pull_one(self, entry, timeout: float):
+        """One item from the stream, waiting at most ``timeout``.
+        Returns ("item", v) | ("wait",) | ("done",) | ("error", repr).
+        A pull that exceeds the timeout keeps running (pool thread /
+        replica loop) and is collected by the NEXT call via
+        entry["pending"] — the RPC thread itself never blocks on a
+        slow producer."""
+        import asyncio
+        import concurrent.futures
         import inspect
 
+        fut = entry["pending"]
+        if fut is None:
+            it = entry["it"]
+            if inspect.isasyncgen(it):
+                fut = asyncio.run_coroutine_threadsafe(
+                    it.__anext__(), self._loop)
+            else:
+                fut = entry["pool"].submit(next, it)
+        entry["pending"] = fut
+        try:
+            value = fut.result(timeout=timeout)
+        except concurrent.futures.TimeoutError:
+            return ("wait",)
+        except (StopIteration, StopAsyncIteration):
+            entry["pending"] = None
+            return ("done",)
+        except Exception as e:  # noqa: BLE001 — user generator raised
+            entry["pending"] = None
+            return ("error", repr(e))
+        entry["pending"] = None
+        return ("item", value)
+
+    def next_chunks(self, sid: str, max_items: int = 64):
+        """Pull from a registered stream: waits up to a short window
+        for the first item, then batches whatever is already ready — a
+        slow producer streams incrementally (possibly empty batches
+        while it computes; the RPC never stalls on it), a fast one
+        amortizes RPCs (ref: proxy.py:763 streaming).  Generator
+        errors tear the stream down and surface to the caller."""
         self._reap_stale_streams()
         entry = self._streams.get(sid)
         if entry is None:
             return {"items": [], "done": True}
-        it = entry[0]
-        entry[1] = time.time()
+        entry["last"] = time.time()
         items: List[Any] = []
-        done = False
         deadline = time.time() + self._BATCH_WINDOW_S
-        try:
-            if inspect.isasyncgen(it):
-                async def pull():
-                    out: List[Any] = []
-                    try:
-                        while len(out) < max_items:
-                            out.append(await it.__anext__())
-                            if time.time() > deadline:
-                                break
-                    except StopAsyncIteration:
-                        return out, True
-                    return out, False
-
-                items, done = self._await(pull())
-            else:
-                try:
-                    while len(items) < max_items:
-                        items.append(next(it))
-                        if time.time() > deadline:
-                            break
-                except StopIteration:
-                    done = True
-        except Exception as e:  # noqa: BLE001 — user generator raised
-            self._close_stream(sid)
-            return {"items": items, "done": True,
-                    "error": repr(e)}
-        if done:
-            self._streams.pop(sid, None)
-            self._exit()
-        return {"items": items, "done": done}
+        while len(items) < max_items:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                break
+            kind, *rest = self._pull_one(entry, remaining)
+            if kind == "item":
+                items.append(rest[0])
+            elif kind == "wait":
+                break
+            elif kind == "done":
+                popped = self._streams.pop(sid, None)
+                if popped is not None:
+                    if popped["pool"] is not None:
+                        popped["pool"].shutdown(wait=False)
+                    self._exit()
+                return {"items": items, "done": True}
+            else:  # error
+                self._close_stream(sid)
+                return {"items": items, "done": True,
+                        "error": rest[0]}
+        return {"items": items, "done": False}
 
     def ongoing(self) -> int:
         return self._ongoing

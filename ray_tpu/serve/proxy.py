@@ -69,16 +69,38 @@ class HTTPProxy:
                 resp = web.StreamResponse()
                 resp.content_type = "application/x-ndjson"
                 await resp.prepare(request)
-                while True:
-                    r = await loop.run_in_executor(
-                        None, lambda: ray_tpu.get(
-                            rep.next_chunks.remote(sid), timeout=60))
-                    for item in r["items"]:
-                        await resp.write(
-                            (json.dumps(item) + "\n").encode())
-                    if r["done"]:
-                        break
-                await resp.write_eof()
+                finished = False
+                try:
+                    while True:
+                        r = await loop.run_in_executor(
+                            None, lambda: ray_tpu.get(
+                                rep.next_chunks.remote(sid),
+                                timeout=60))
+                        for item in r["items"]:
+                            await resp.write(
+                                (json.dumps(item) + "\n").encode())
+                        if r.get("error"):
+                            # Mid-stream failure: status already went
+                            # out — emit an explicit trailer line so
+                            # clients can distinguish truncation from
+                            # completion.
+                            await resp.write((json.dumps(
+                                {"__rt_stream_error__": r["error"]})
+                                + "\n").encode())
+                            finished = True
+                            break
+                        if r["done"]:
+                            finished = True
+                            break
+                    await resp.write_eof()
+                finally:
+                    if not finished:
+                        # Client went away mid-stream: free the
+                        # replica-side generator now, not at TTL.
+                        try:
+                            rep.cancel_stream.remote(sid)
+                        except Exception:
+                            pass
                 return resp
             if isinstance(result, (dict, list, str, int, float, bool,
                                    type(None))):

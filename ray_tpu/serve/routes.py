@@ -15,13 +15,19 @@ class RouteTable:
         self._cache: Dict[str, str] = {}
         self._version = -1
         self._poller: Optional[threading.Thread] = None
+        # The gRPC proxy calls get() from a thread POOL: without this
+        # lock, concurrent first requests each start a poller.
+        self._start_lock = threading.Lock()
 
     def get(self) -> Dict[str, str]:
         """Current {route_prefix: deployment_name}; starts the poller
         on first use (synchronous first fetch so the first request
         routes)."""
         if self._poller is None or not self._poller.is_alive():
-            self._start()
+            with self._start_lock:
+                if self._poller is None or \
+                        not self._poller.is_alive():
+                    self._start()
         return self._cache
 
     def resolve(self, path: str) -> Optional[str]:

@@ -5,7 +5,8 @@ Role-equivalent to the reference's Ray Tune (ref: SURVEY.md §2.4).
 
 from .schedulers import (ASHAScheduler, FIFOScheduler,  # noqa
                          PopulationBasedTraining)
-from .search import (choice, grid_search, loguniform, randint,  # noqa
-                     sample_from, uniform)
+from .search import (Searcher, TPESearcher, choice,  # noqa
+                     grid_search, loguniform, randint, sample_from,
+                     uniform)
 from .tuner import (ResultGrid, TuneConfig, Tuner,  # noqa: F401
                     get_checkpoint, report)

@@ -33,6 +33,10 @@ class TuneConfig:
     scheduler: Any = None
     max_concurrent_trials: int = 2
     seed: Optional[int] = None
+    # A search.Searcher (e.g. TPESearcher): configs are suggested one
+    # trial at a time, informed by completed results, instead of the
+    # up-front BasicVariantGenerator expansion (ref: tune/search/).
+    search_alg: Any = None
 
 
 @ray_tpu.remote(max_concurrency=4)
@@ -193,20 +197,57 @@ class Tuner:
         import cloudpickle
 
         payload = cloudpickle.dumps(fn)
-        variants = BasicVariantGenerator(
-            self.param_space, tc.num_samples, tc.seed).variants()
-        trials = [Trial(trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:6]}",
-                        config=cfg) for i, cfg in enumerate(variants)]
+        searcher = tc.search_alg
+        trials: List[Trial]
+        if searcher is not None:
+            searcher.setup(self.param_space, tc.metric, tc.mode,
+                           tc.seed)
+            trials = []
+            pending: List[Trial] = []
+            to_suggest = tc.num_samples
+        else:
+            variants = BasicVariantGenerator(
+                self.param_space, tc.num_samples, tc.seed).variants()
+            trials = [
+                Trial(trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:6]}",
+                      config=cfg) for i, cfg in enumerate(variants)]
+            pending = list(trials)
+            to_suggest = 0
+
+        def _next_trial() -> Optional[Trial]:
+            nonlocal to_suggest
+            if pending:
+                return pending.pop(0)
+            if to_suggest > 0:
+                to_suggest -= 1
+                tid = (f"trial_{len(trials):04d}_"
+                       f"{uuid.uuid4().hex[:6]}")
+                t = Trial(trial_id=tid, config=searcher.suggest(tid))
+                trials.append(t)
+                return t
+            return None
+
+        def _completed(t: Trial) -> None:
+            if searcher is not None:
+                try:
+                    searcher.on_trial_complete(t.trial_id,
+                                               t.last_metrics())
+                except Exception:
+                    pass
+
         scheduler = tc.scheduler or FIFOScheduler()
-        pending = list(trials)
         running: List[Trial] = []
-        while pending or running:
-            while pending and len(running) < tc.max_concurrent_trials:
-                t = pending.pop(0)
+        while pending or running or to_suggest:
+            while len(running) < tc.max_concurrent_trials:
+                t = _next_trial()
+                if t is None:
+                    break
                 t.actor = _TrialActor.remote()
                 t.run_ref = t.actor.run.remote(payload, t.config)
                 t.status = "RUNNING"
                 running.append(t)
+            if not running:
+                continue
             # Poll reports and completion.
             done_refs, _ = ray_tpu.wait([t.run_ref for t in running],
                                         num_returns=1, timeout=0.2)
@@ -237,6 +278,7 @@ class Tuner:
                 if stopped:
                     ray_tpu.kill(t.actor)
                     running.remove(t)
+                    _completed(t)
                     continue
                 if exploit_decision is not None:
                     # PBT: adopt the source's checkpoint + mutated
@@ -275,4 +317,5 @@ class Tuner:
                         t.status = "ERROR"
                     ray_tpu.kill(t.actor)
                     running.remove(t)
+                    _completed(t)
         return ResultGrid(trials, tc.metric, tc.mode)

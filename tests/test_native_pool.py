@@ -95,3 +95,90 @@ def test_cluster_on_pool_backend():
     finally:
         os.environ.pop("RT_OBJECT_STORE_BACKEND", None)
         ray_tpu.shutdown()
+
+
+def _sanitized_pool_exercise_script() -> str:
+    """Driver script run under LD_PRELOAD=<sanitizer runtime>: single-
+    process churn (split/coalesce/robust-mutex) + a child process
+    attaching and freeing cross-process."""
+    return r"""
+import os, subprocess, sys
+import numpy as np
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import PoolObjectStore
+
+session = f"san_{os.getpid()}"
+store = PoolObjectStore(session, 32 * 1024 * 1024)
+try:
+    # Alloc/free churn: split + coalesce under instrumentation.
+    oids = [ObjectID(os.urandom(16)) for _ in range(60)]
+    for i, o in enumerate(oids):
+        store.put_raw(o, bytes([i % 251]) * (50_000 + 1000 * (i % 7)))
+    for o in oids[::2]:
+        store.delete(o)
+    big = ObjectID(os.urandom(16))
+    arr = np.arange(400_000, dtype=np.float64)
+    size = store.create_and_seal(big, {"x": arr})
+    out = store.get(big, size)
+    np.testing.assert_array_equal(out["x"], arr)
+    # Cross-process attach path (robust mutex, shared free list).
+    child = '''
+import os, sys
+sys.path.insert(0, %r)
+os.environ["RT_SHM_POOL_SANITIZE"] = %r
+from ray_tpu._native.shm_pool import ShmPool
+pool = ShmPool(sys.argv[1])   # slab_bytes=0 -> attach existing
+data = pool.get_copy(bytes.fromhex(sys.argv[2]))
+assert data is not None and len(data) == int(sys.argv[3])
+pool.close()
+print("CHILD_OK")
+'''
+    r = subprocess.run(
+        [sys.executable, "-c", child % (sys.path[0],
+                                        os.environ.get("RT_SHM_POOL_SANITIZE", "")),
+         f"/rtpool_{session}", big.binary().hex(), str(size)],
+        capture_output=True, text=True, env=os.environ, timeout=120)
+    assert r.returncode == 0 and "CHILD_OK" in r.stdout, \
+        r.stdout + r.stderr
+    print("EXERCISE_OK")
+finally:
+    from ray_tpu._native.shm_pool import ShmPool
+    store._pool.close()
+    ShmPool.unlink(f"/rtpool_{session}")
+"""
+
+
+@pytest.mark.parametrize("sanitize", ["address", "thread"])
+def test_pool_under_sanitizer(sanitize, tmp_path):
+    """Build src/shm_pool.cpp with ASAN/TSAN and run the allocator
+    exercise under the instrumented library (ref: .bazelrc:104-125
+    sanitizer configs — round-3 VERDICT item 10)."""
+    import subprocess
+    import sys
+
+    from ray_tpu._native import build_library, sanitizer_runtime
+
+    runtime = sanitizer_runtime(sanitize)
+    if runtime is None or not os.path.exists(runtime):
+        pytest.skip(f"no {sanitize} sanitizer runtime")
+    lib = build_library("shm_pool.cpp", sanitize=sanitize)
+    assert lib is not None, "sanitized build failed"
+    env = {
+        **os.environ,
+        "LD_PRELOAD": runtime,
+        "RT_SHM_POOL_SANITIZE": sanitize,
+        # Python itself "leaks" at exit; only the pool's errors matter.
+        "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
+        "TSAN_OPTIONS": "halt_on_error=1",
+        "PYTHONPATH": os.pathsep.join(sys.path),
+    }
+    script = _sanitized_pool_exercise_script()
+    r = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=300)
+    combined = r.stdout + r.stderr
+    assert r.returncode == 0, combined[-4000:]
+    assert "EXERCISE_OK" in combined, combined[-2000:]
+    for marker in ("AddressSanitizer", "ThreadSanitizer",
+                   "runtime error"):
+        assert f"ERROR: {marker}" not in combined, combined[-4000:]
+        assert f"WARNING: {marker}" not in combined, combined[-4000:]

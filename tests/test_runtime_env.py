@@ -154,3 +154,21 @@ def test_pip_runtime_env_worker_in_venv(cluster_rt, tmp_path):
     # worker pool serves a warm worker keyed by the env hash.
     value2, venv_py2 = ray_tpu.get(use_dep.remote(), timeout=60)
     assert (value2, venv_py2) == (42, venv_py)
+
+
+def test_pip_env_build_failure_surfaces_fast(cluster_rt):
+    """A pip env that cannot build must FAIL the task with
+    RuntimeEnvSetupError (round-4 review: previously the agent
+    respawned bootstraps — and re-ran the install — forever)."""
+    from ray_tpu import RuntimeEnvSetupError
+
+    @ray_tpu.remote(runtime_env={"pip": ["--no-index",
+                                         "definitely-no-such-pkg-xyz"]})
+    def doomed():
+        return 1
+
+    t0 = __import__("time").time()
+    with pytest.raises(RuntimeEnvSetupError) as ei:
+        ray_tpu.get(doomed.remote(), timeout=180)
+    assert "pip env build failed" in str(ei.value)
+    assert __import__("time").time() - t0 < 150
