@@ -168,6 +168,10 @@ class RemoteFunction:
             scheduling=_strategy(opts),
             runtime_env=opts["runtime_env"],
         )
+        if cfg.tracing_enabled:
+            from ..util import tracing
+
+            tracing.inject(spec)
         refs = rt.submit_task(spec)
         return refs[0] if spec.num_returns == 1 else refs
 
@@ -244,6 +248,10 @@ class ActorHandle:
             max_concurrency=self._max_concurrency,
             name=f"{self._class_name}.{method}",
         )
+        if rt.config.tracing_enabled:
+            from ..util import tracing
+
+            tracing.inject(spec)
         refs = rt.submit_actor_task(spec)
         return refs[0] if num_returns == 1 else refs
 

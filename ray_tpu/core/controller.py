@@ -116,7 +116,7 @@ class Controller:
             "list_actors", "cluster_shutdown", "ping", "drain_node",
             "task_events", "list_tasks", "get_task", "list_objects",
             "list_jobs", "report_metrics", "metrics_text",
-            "get_load_metrics", "worker_logs",
+            "metrics_history", "get_load_metrics", "worker_logs",
         ]:
             self.server.register(name, getattr(self, name))
 
@@ -722,9 +722,57 @@ class Controller:
 
     # --------------------------------------------------------- metrics
     async def report_metrics(self, p):
+        now = time.time()
         self.metrics_sources[p["source"]] = {
-            "snapshot": p["snapshot"], "ts": time.time()}
+            "snapshot": p["snapshot"], "ts": now}
+        # Bounded per-source history for dashboard time series (ref:
+        # dashboard/modules/reporter/ — utilization over time, not
+        # just the current snapshot).  ~30 min at the default 5 s
+        # report period; never persisted.
+        from collections import deque
+
+        hist = getattr(self, "_metrics_history", None)
+        if hist is None:
+            hist = self._metrics_history = {}
+        flat: Dict[str, float] = {}
+        for metric in p["snapshot"]:
+            for s in metric.get("series", []):
+                tags = s.get("tags") or {}
+                key = metric["name"]
+                if tags:
+                    key += "{" + ",".join(
+                        f"{k}={v}" for k, v in sorted(tags.items())) \
+                        + "}"
+                flat[key] = float(s["value"])
+        dq = hist.get(p["source"])
+        if dq is None:
+            dq = hist[p["source"]] = deque(maxlen=360)
+        dq.append((now, flat))
         return {"ok": True}
+
+    def _prune_metrics_history(self, now: float) -> None:
+        """Dead sources must not leak deques under worker churn (the
+        same contract metrics_sources keeps)."""
+        hist = getattr(self, "_metrics_history", None)
+        if not hist:
+            return
+        horizon = max(self.config.metrics_report_period_s * 6, 30.0)
+        for src in [s for s, dq in hist.items()
+                    if not dq or now - dq[-1][0] > horizon]:
+            del hist[src]
+
+    async def metrics_history(self, p):
+        """Per-source time series: {source: [[ts, {metric: value}],
+        ...]} (ref: dashboard reporter plane)."""
+        hist = getattr(self, "_metrics_history", {})
+        self._prune_metrics_history(time.time())
+        want = (p or {}).get("source")
+        out = {}
+        for src, dq in hist.items():
+            if want and src != want:
+                continue
+            out[src] = [[ts, vals] for ts, vals in dq]
+        return out
 
     async def metrics_text(self, _p):
         from ray_tpu.util.metrics import render_prometheus
@@ -737,6 +785,7 @@ class Controller:
         for src in [s for s, v in self.metrics_sources.items()
                     if now - v["ts"] > horizon]:
             del self.metrics_sources[src]
+        self._prune_metrics_history(now)
         sources = {s: v["snapshot"]
                    for s, v in self.metrics_sources.items()}
         # Controller-internal gauges, rendered with the same pipeline.

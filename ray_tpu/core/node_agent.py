@@ -479,12 +479,40 @@ class NodeAgent:
             pass
         return {"ok": True}
 
+    def _host_cpu_util(self) -> float:
+        """Host CPU utilization since the previous sample, from
+        /proc/stat deltas (ref: dashboard/modules/reporter/
+        reporter_agent.py psutil.cpu_percent; /proc keeps the agent
+        dependency-free)."""
+        try:
+            with open("/proc/stat") as f:
+                parts = f.readline().split()[1:]
+            vals = [int(x) for x in parts[:8]]
+        except (OSError, ValueError):
+            return 0.0
+        total = sum(vals)
+        idle = vals[3] + (vals[4] if len(vals) > 4 else 0)
+        prev = getattr(self, "_prev_cpu_sample", None)
+        self._prev_cpu_sample = (total, idle)
+        if prev is None or total <= prev[0]:
+            return 0.0
+        dt = total - prev[0]
+        return max(0.0, min(1.0, 1.0 - (idle - prev[1]) / dt))
+
     def _node_metrics_snapshot(self) -> List[Dict]:
         n_obj, used, cap = self.directory.stats()
         states: Dict[str, int] = {}
         for w in self.workers.values():
             states[w.state] = states.get(w.state, 0) + 1
         return [
+            {"name": "rt_node_cpu_util", "kind": "gauge",
+             "description": "Host CPU utilization (0-1).",
+             "series": [{"tags": {},
+                         "value": self._host_cpu_util()}]},
+            {"name": "rt_node_mem_util", "kind": "gauge",
+             "description": "Host memory utilization (0-1).",
+             "series": [{"tags": {},
+                         "value": self._memory_usage_fraction()}]},
             {"name": "rt_node_workers", "kind": "gauge",
              "description": "Worker processes by state.",
              "series": [{"tags": {"state": s}, "value": v}

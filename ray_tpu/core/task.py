@@ -80,6 +80,9 @@ class TaskSpec:
     lifetime: Optional[str] = None     # None | "detached"
     # Lineage: owner address is attached by the submitting worker.
     owner_hint: str = ""
+    # Tracing: submitter's span context (ref: tracing_helper.py:88
+    # span injection through submission); None when tracing is off.
+    trace_ctx: Optional[Dict[str, str]] = None
 
     def return_object_ids(self) -> List[ObjectID]:
         return [

@@ -312,20 +312,35 @@ class Worker:
         ctypes.pythonapi.PyThreadState_SetAsyncExc(
             ctypes.c_ulong(threading.get_ident()), None)
         self._current_sync_task = (spec.task_id, threading.get_ident())
-        self._emit_event(spec, "RUNNING")
+        # Tracing: execute AS a child span of the submitter's context,
+        # so nested .remote() calls inherit it and task events carry
+        # the trace fields (ref: tracing_helper.py:88).
+        span = None
+        if spec.trace_ctx:
+            from ..util import tracing as _tracing
+
+            span = _tracing.child_context(spec.trace_ctx)
+            _tracing.set_span_context(span)
+        trace_extra = dict(span) if span else {}
+        self._emit_event(spec, "RUNNING", **trace_extra)
         try:
             pos, kwargs = self._resolve_args(spec)
             result = fn(*pos, **kwargs)
             out = self._package_returns(spec, result)
-            self._emit_event(spec, "FINISHED")
+            self._emit_event(spec, "FINISHED", **trace_extra)
             return out
         except BaseException as e:  # noqa: BLE001 — shipped to owner
             kind = ActorError if spec.kind.name == "ACTOR_TASK" else TaskError
-            self._emit_event(spec, "FAILED", error=repr(e))
+            self._emit_event(spec, "FAILED", error=repr(e),
+                             **trace_extra)
             return TaskResult(task_id=spec.task_id, ok=False,
                               error=kind.from_exception(e))
         finally:
             self._current_sync_task = None
+            if span is not None:
+                from ..util import tracing as _tracing
+
+                _tracing.set_span_context(None)
             self.runtime.set_current_task(prev_task)
             self.runtime.current_lease_id = prev_lease
 
@@ -445,7 +460,18 @@ class Worker:
         # concurrent async methods would cross-contaminate it (object
         # IDs stay unique regardless: the put counter is process-global).
         loop = asyncio.get_event_loop()
-        self._emit_event(spec, "RUNNING")
+        # Tracing parity with _execute_sync: async methods carry the
+        # submitter's span too.  (No set_span_context here — the
+        # thread-local would cross-contaminate concurrent coroutines,
+        # like the task-context note above; nested .remote() calls made
+        # from async methods are unattributed, a documented limit.)
+        trace_extra = {}
+        if spec.trace_ctx:
+            from ..util import tracing as _tracing
+
+            trace_extra = dict(
+                _tracing.child_context(spec.trace_ctx) or {})
+        self._emit_event(spec, "RUNNING", **trace_extra)
         try:
             # Arg resolution may block on remote objects; keep it off the
             # event loop so other handlers stay live.
@@ -454,10 +480,11 @@ class Worker:
             result = await method(*pos, **kwargs)
             out = await loop.run_in_executor(
                 self._task_executor, self._package_returns, spec, result)
-            self._emit_event(spec, "FINISHED")
+            self._emit_event(spec, "FINISHED", **trace_extra)
             return out
         except BaseException as e:  # noqa: BLE001
-            self._emit_event(spec, "FAILED", error=repr(e))
+            self._emit_event(spec, "FAILED", error=repr(e),
+                             **trace_extra)
             return TaskResult(task_id=spec.task_id, ok=False,
                               error=ActorError.from_exception(e))
 

@@ -59,7 +59,8 @@ async function refresh() {
                                             "node_id", "worker_pid",
                                             "error"]) +
     "<h2>Jobs</h2>" + table(jobs, ["job_id", "driver", "alive"]) +
-    `<p><a href="/metrics">/metrics</a> (Prometheus)</p>`;
+    `<p><a href="/metrics">/metrics</a> (Prometheus) · ` +
+    `<a href="/timeseries">/timeseries</a> (utilization)</p>`;
 }
 refresh(); setInterval(refresh, 3000);
 </script></body></html>
@@ -111,6 +112,64 @@ def create_app(address: Optional[str] = None):
     async def metrics(_req):
         return web.Response(text=await call(state_api.metrics_text),
                             content_type="text/plain")
+
+    async def timeseries_json(req):
+        return web.json_response(json.loads(json.dumps(
+            await call(state_api.metrics_history,
+                       source=req.query.get("source")),
+            default=repr)))
+
+    def _sparkline(points, width=420, height=48, y_max=None):
+        """Server-rendered SVG polyline — no JS chart dependency."""
+        if not points:
+            return "<svg/>"
+        top = y_max if y_max is not None else max(
+            max(points), 1e-9) * 1.05
+        n = max(len(points) - 1, 1)
+        coords = " ".join(
+            f"{i * width / n:.1f},"
+            f"{height - min(v / top, 1.0) * height:.1f}"
+            for i, v in enumerate(points))
+        return (f'<svg width="{width}" height="{height}" '
+                f'style="background:#f6f6f6">'
+                f'<polyline points="{coords}" fill="none" '
+                f'stroke="#06c" stroke-width="1.5"/>'
+                f'<text x="2" y="12" font-size="10">'
+                f'last={points[-1]:.3g} max={max(points):.3g}</text>'
+                f"</svg>")
+
+    async def timeseries(_req):
+        """Per-node utilization over time (ref: dashboard/modules/
+        reporter/ — the round-3 'snapshot page only' weak item)."""
+        hist = await call(state_api.metrics_history)
+        parts = ["<html><head><meta http-equiv=refresh content=5>"
+                 "<title>rt timeseries</title></head><body>"
+                 "<h1>Node utilization</h1>"]
+        plots = [("rt_node_cpu_util", "CPU util", 1.0),
+                 ("rt_node_mem_util", "Memory util", 1.0),
+                 ("rt_node_object_store_bytes{kind=used}",
+                  "Object store bytes", None),
+                 ("rt_node_leases_active", "Active leases", None)]
+        for src in sorted(hist):
+            rows = hist[src]
+            # Only sources that actually carry node-utilization
+            # gauges (worker processes report task counters, not
+            # rt_node_*; plotting them would render all-zero noise).
+            if not rows or not any(
+                    k.startswith("rt_node_") for k in rows[-1][1]):
+                continue
+            parts.append(f"<h2>{src}</h2><table>")
+            for key, label, y_max in plots:
+                series = [vals.get(key, 0.0) for _ts, vals in rows]
+                parts.append(
+                    f"<tr><td>{label}</td><td>"
+                    f"{_sparkline(series, y_max=y_max)}</td></tr>")
+            parts.append("</table>")
+        parts.append('<p><a href="/">back</a> · '
+                     '<a href="/api/timeseries">json</a></p>'
+                     "</body></html>")
+        return web.Response(text="".join(parts),
+                            content_type="text/html")
 
     def _sel(req):
         kw = {}
@@ -166,6 +225,8 @@ def create_app(address: Optional[str] = None):
     app.router.add_get("/api/stack", stack)
     app.router.add_get("/api/profile", profile)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/timeseries", timeseries)
+    app.router.add_get("/api/timeseries", timeseries_json)
     return app
 
 

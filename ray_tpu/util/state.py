@@ -99,6 +99,14 @@ def metrics_text(*, address: Optional[str] = None) -> str:
     return _call("metrics_text", {}, address)["text"]
 
 
+def metrics_history(*, source: Optional[str] = None,
+                    address: Optional[str] = None) -> Dict[str, Any]:
+    """Per-node metric time series: {source: [[ts, {metric: value}],
+    ...]} over the controller's retained window (ref:
+    dashboard/modules/reporter/ utilization history)."""
+    return _call("metrics_history", {"source": source}, address)
+
+
 def timeline(filename: Optional[str] = None, *,
              address: Optional[str] = None) -> Any:
     """Chrome-trace (chrome://tracing / perfetto) export of task events

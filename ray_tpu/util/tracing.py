@@ -1,0 +1,110 @@
+"""Distributed trace spans propagated through task submission.
+
+Role-equivalent to the reference's OTel tracing glue (ref:
+python/ray/util/tracing/tracing_helper.py:88 — the submit path injects
+the current span context into the task spec; the worker opens a child
+span around execution).  Dependency-free redesign: span contexts are
+(trace_id, span_id) pairs riding ``TaskSpec.trace_ctx``; finished
+spans are recorded as task events (the existing sink) with trace
+fields, and ``trace_tree()`` reassembles the cross-process call tree.
+Enable with ``RT_TRACING_ENABLED=1`` (config flag tracing_enabled).
+
+Usage (driver side)::
+
+    with tracing.start_span("ingest"):
+        ref = work.remote(x)          # span context travels with it
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class _Ctx(threading.local):
+    def __init__(self):
+        self.current: Optional[Dict[str, str]] = None
+
+
+_ctx = _Ctx()
+
+
+def _new_id(nbytes: int = 8) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def current_span_context() -> Optional[Dict[str, str]]:
+    """{"trace_id", "span_id"} of the active span, or None."""
+    return _ctx.current
+
+
+def set_span_context(ctx: Optional[Dict[str, str]]) -> None:
+    """Adopt a propagated context (the worker does this around task
+    execution, so nested .remote() calls nest under the task's span)."""
+    _ctx.current = dict(ctx) if ctx else None
+
+
+class start_span:
+    """Context manager opening a span under the current one."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._prev: Optional[Dict[str, str]] = None
+        self.ctx: Dict[str, str] = {}
+
+    def __enter__(self) -> "start_span":
+        parent = _ctx.current
+        self.ctx = {
+            "trace_id": (parent or {}).get("trace_id") or _new_id(16),
+            "span_id": _new_id(),
+        }
+        if parent:
+            self.ctx["parent_span_id"] = parent["span_id"]
+        self._prev = parent
+        self._t0 = time.time()
+        _ctx.current = self.ctx
+        return self
+
+    def __exit__(self, *exc):
+        _ctx.current = self._prev
+        return False
+
+
+def inject(spec) -> None:
+    """Submit-side: attach the current span context to a TaskSpec
+    (ref: tracing_helper.py _inject_tracing_into_function)."""
+    ctx = _ctx.current
+    if ctx is not None:
+        spec.trace_ctx = {"trace_id": ctx["trace_id"],
+                          "parent_span_id": ctx["span_id"]}
+
+
+def child_context(trace_ctx: Optional[Dict[str, str]]
+                  ) -> Optional[Dict[str, str]]:
+    """Worker-side: the span this task executes AS."""
+    if not trace_ctx:
+        return None
+    return {"trace_id": trace_ctx["trace_id"],
+            "span_id": _new_id(),
+            "parent_span_id": trace_ctx.get("parent_span_id", "")}
+
+
+def trace_tree(task_records: List[Dict[str, Any]],
+               trace_id: Optional[str] = None) -> Dict[str, Any]:
+    """Reassemble spans from the controller's task records (e.g.
+    ``state.list_tasks()``): {trace_id: [span, ...]} with each span
+    {span_id, parent_span_id, name, start, end, task_id}."""
+    spans: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in task_records:
+        tid = rec.get("trace_id")
+        if not tid or (trace_id and tid != trace_id):
+            continue
+        times = list((rec.get("times") or {}).values()) or [0.0]
+        spans.setdefault(tid, []).append({
+            "trace_id": tid, "span_id": rec.get("span_id"),
+            "parent_span_id": rec.get("parent_span_id", ""),
+            "name": rec.get("name"), "task_id": rec.get("task_id"),
+            "start": min(times), "end": max(times)})
+    return spans
