@@ -107,15 +107,20 @@ class GcpTpuApi:
     # ------------------------------------------------- queued resources
     def create_queued_resource(self, qr_id: str, node_id: str,
                                accelerator_type: str,
-                               runtime_version: str) -> Dict:
+                               runtime_version: str,
+                               labels: Optional[Dict[str, str]] = None,
+                               ) -> Dict:
+        node: Dict = {"acceleratorType": accelerator_type,
+                      "runtimeVersion": runtime_version}
+        if labels:
+            node["labels"] = labels
         return self._request(
             "POST",
             f"{self.parent}/queuedResources?queuedResourceId={qr_id}",
             {"tpu": {"nodeSpec": [{
                 "parent": self.parent,
                 "nodeId": node_id,
-                "node": {"acceleratorType": accelerator_type,
-                         "runtimeVersion": runtime_version}}]}})
+                "node": node}]}})
 
     def get_queued_resource(self, qr_id: str) -> Dict:
         return self._request(
@@ -201,8 +206,11 @@ class GCPTpuNodeProvider(RemoteNodeProvider):
             n = next(self._counter)
         node_id = (f"{self.spec.cluster_name}-{node_type}"
                    f"-{self._nonce}-{n}".replace("_", "-").lower())
-        labels = {"rt-cluster": self.spec.cluster_name,
-                  "rt-node-type": node_type}
+        # GCP label values must be lowercase [a-z0-9-]; sanitize the
+        # same way node IDs are so create never trips the charset rule.
+        labels = {"rt-cluster": self._label_cluster_name(),
+                  "rt-node-type":
+                      node_type.replace("_", "-").lower()}
         # ANY failure between the capacity request and a recorded,
         # bootstrapped node must delete the capacity — a timed-out
         # queued resource that provisions later, or a node stuck in
@@ -215,7 +223,7 @@ class GCPTpuNodeProvider(RemoteNodeProvider):
                 # creates nodes directly — modern fleets need this).
                 self.api.create_queued_resource(
                     node_id, node_id, t.accelerator_type,
-                    t.runtime_version)
+                    t.runtime_version, labels)
                 deadline = time.time() + self.create_timeout_s
                 while True:
                     qr = self.api.get_queued_resource(node_id)
@@ -286,6 +294,11 @@ class GCPTpuNodeProvider(RemoteNodeProvider):
                 logger.warning("delete of QR %s failed", node_id,
                                exc_info=True)
 
+    def _label_cluster_name(self) -> str:
+        """cluster_name sanitized to GCP's label-value charset
+        (lowercase [a-z0-9-]) — must match what create_node stamps."""
+        return self.spec.cluster_name.replace("_", "-").lower()
+
     def cleanup_cluster_capacity(self) -> List[str]:
         """Delete EVERY cloud node labeled with this cluster — the
         `rt down` backstop for autoscaler-launched nodes that never
@@ -303,11 +316,17 @@ class GCPTpuNodeProvider(RemoteNodeProvider):
                     or (node.get("name") or "").rsplit("/", 1)[-1])
             if not name:
                 continue
-            if labels.get("rt-cluster") != self.spec.cluster_name \
-                    and not name.startswith(
-                        self.spec.cluster_name.replace("_", "-")
-                        .lower() + "-"):
-                continue
+            label = labels.get("rt-cluster")
+            if label != self._label_cluster_name():
+                # A node labeled for a DIFFERENT cluster is never ours,
+                # even if its name shares our prefix ("rt" vs
+                # "rt-demo"); the prefix fallback exists only for
+                # legacy/QR nodes created with no label at all.
+                if label is not None:
+                    continue
+                if not name.startswith(
+                        self._label_cluster_name() + "-"):
+                    continue
             self._delete_cloud_node(name)
             deleted.append(name)
         return deleted

@@ -89,14 +89,21 @@ class NormalizeObs(ConnectorV2):
         obs = np.asarray(batch["obs"], np.float32)
         if self._mean is None:
             self._mean = np.zeros(obs.shape[1:], np.float64)
-            self._m2 = np.ones(obs.shape[1:], np.float64)
+            self._m2 = np.zeros(obs.shape[1:], np.float64)
         if self.update:
             for row in obs:
                 self._count += 1
                 d = row - self._mean
                 self._mean += d / self._count
                 self._m2 += d * (row - self._mean)
-        std = np.sqrt(self._m2 / max(self._count - 1, 1)) + 1e-8
+        if self._count < 2:
+            # Too few samples for a variance estimate (e.g. a frozen
+            # inference copy running before the first state sync):
+            # pass observations through near-identity instead of
+            # dividing by ~1e-8 and saturating everything at ±clip.
+            std = np.ones_like(self._mean)
+        else:
+            std = np.sqrt(self._m2 / (self._count - 1)) + 1e-8
         batch["obs"] = np.clip((obs - self._mean) / std,
                                -self.clip, self.clip).astype(np.float32)
         return batch

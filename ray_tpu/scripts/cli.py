@@ -150,16 +150,47 @@ def cmd_start(args) -> int:
             [sys.executable, "-u", "-m", "ray_tpu.client.server",
              "--address", ctl_addr,
              "--port", str(args.client_server_port)],
-            stdout=_sp.PIPE, stderr=_sp.DEVNULL, text=True)
+            stdout=_sp.PIPE, stderr=_sp.DEVNULL)
+        # A hung child that never prints the port line must not hang
+        # `rt start`: poll the pipe fd so the 30s deadline applies even
+        # mid-line, then fall through to the warning path.
+        import selectors as _selectors
+
+        sel = _selectors.DefaultSelector()
+        sel.register(cs_proc.stdout, _selectors.EVENT_READ)
         deadline = time.time() + 30
+        buf = ""
+        eof = False
         while time.time() < deadline:
-            line = cs_proc.stdout.readline()
-            if line.startswith("RT_CLIENT_SERVER_PORT="):
-                host = ctl_addr.rsplit(":", 1)[0]
-                client_addr = f"rt://{host}:{line.split('=')[1].strip()}"
+            if not sel.select(timeout=max(0.0, deadline - time.time())):
+                break  # deadline expired with no output
+            chunk = os.read(cs_proc.stdout.fileno(), 4096).decode(
+                "utf-8", "replace")
+            if not chunk:
+                eof = True  # child closed stdout
                 break
-            if not line:
+            buf += chunk
+            # Parse only newline-terminated lines; a read can race the
+            # child's write mid-line, and a partial "...PORT=10" must
+            # not become the advertised port.
+            *lines, buf = buf.split("\n")
+            for line in lines:
+                if line.startswith("RT_CLIENT_SERVER_PORT="):
+                    host = ctl_addr.rsplit(":", 1)[0]
+                    client_addr = (
+                        f"rt://{host}:{line.split('=')[1].strip()}")
+                    break
+            if client_addr is not None:
                 break
+        if client_addr is None and eof and \
+                buf.startswith("RT_CLIENT_SERVER_PORT="):
+            # Child closed stdout right after an unterminated port line
+            # — still a valid announcement.  ONLY on EOF: on deadline
+            # expiry the child may be mid-write and the buffer could
+            # hold a truncated port.
+            host = ctl_addr.rsplit(":", 1)[0]
+            client_addr = f"rt://{host}:{buf.split('=')[1].strip()}"
+        sel.close()
         if client_addr is None:
             print("warning: rt:// client server failed to start",
                   file=sys.stderr)

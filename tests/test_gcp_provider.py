@@ -96,6 +96,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "nodeId": spec["nodeId"], "state": "CREATING",
                 "acceleratorType":
                     spec["node"].get("acceleratorType"),
+                "labels": spec["node"].get("labels") or {},
                 "ready_at": time.time() + fake.ready_delay}
             return self._reply(200, fake._op())
         return self._reply(404, {"error": "bad path " + self.path})
@@ -345,10 +346,21 @@ def test_down_sweeps_unrecorded_cluster_nodes(fake_gcp):
         api.create_node("other-cluster-node", "v5litepod-4",
                         "tpu-ubuntu2204-base",
                         labels={"rt-cluster": "elsewhere"})
+        # A sibling cluster whose NAME shares our prefix but whose
+        # label names another cluster must survive the sweep ("rt"
+        # down must not delete "rt-demo"'s capacity), while an
+        # unlabeled legacy node with our prefix is still swept.
+        api.create_node("gcptest-demo-tpu-worker-1", "v5litepod-4",
+                        "tpu-ubuntu2204-base",
+                        labels={"rt-cluster": "gcptest-demo"})
+        api.create_node("gcptest-legacy-unlabeled-2", "v5litepod-4",
+                        "tpu-ubuntu2204-base")
         provider = GCPTpuNodeProvider(spec, address)
         deleted = provider.cleanup_cluster_capacity()
-        assert deleted == ["gcptest-tpu-worker-dead1-7"]
-        assert list(fake_gcp.nodes) == ["other-cluster-node"]
+        assert sorted(deleted) == ["gcptest-legacy-unlabeled-2",
+                                   "gcptest-tpu-worker-dead1-7"]
+        assert sorted(fake_gcp.nodes) == ["gcptest-demo-tpu-worker-1",
+                                          "other-cluster-node"]
     finally:
         ray_tpu.shutdown()
 
