@@ -82,6 +82,50 @@ class _CancelledInFlight(Exception):
     """Internal: submission observed its cancel flag mid-flight."""
 
 
+class _PooledLease:
+    """A granted worker lease cached by the owner for task reuse (ref:
+    normal_task_submitter.h:74 — the submitter caches leased workers
+    and pipelines same-shaped tasks onto them instead of paying a
+    lease round-trip per task).  At most ONE task runs on a pooled
+    lease at a time (matching OnWorkerIdle semantics), so queued tasks
+    can never deadlock behind a blocked task on the same worker."""
+
+    __slots__ = ("lease_id", "agent_addr", "worker_addr", "worker_id",
+                 "chip_ids", "idle_since")
+
+    def __init__(self, lease_id, agent_addr, worker_addr, worker_id,
+                 chip_ids):
+        self.lease_id = lease_id
+        self.agent_addr = agent_addr
+        self.worker_addr = worker_addr
+        self.worker_id = worker_id
+        self.chip_ids = chip_ids
+        self.idle_since = 0.0
+
+
+class _SchedKeyState:
+    """Owner-side per-scheduling-key submission state: a FIFO of tasks
+    waiting for a worker, the pool of granted leases, and the set of
+    in-flight lease requests (ref: SchedulingKey entries in
+    normal_task_submitter.h — one task queue + worker set + pending
+    lease request per (resource shape, runtime env) class)."""
+
+    __slots__ = ("key", "base_payload", "queue", "leases", "idle",
+                 "request_agents")
+
+    def __init__(self, key, base_payload):
+        self.key = key
+        self.base_payload = base_payload
+        from collections import deque
+
+        # (spec, _Submission, future-of-TaskResult) triples.
+        self.queue = deque()
+        self.leases: Dict[int, _PooledLease] = {}
+        self.idle: List[_PooledLease] = []
+        # request_id -> agent address currently holding that request.
+        self.request_agents: Dict[str, str] = {}
+
+
 class ClusterRuntime(BaseRuntime):
     def __init__(self, config: RuntimeConfig, *,
                  address: Optional[str] = None,
@@ -150,6 +194,10 @@ class ClusterRuntime(BaseRuntime):
         self._lineage: Dict[ObjectID, TaskSpec] = {}
         self._reconstructing: Dict[ObjectID, asyncio.Future] = {}
         self._actor_submit_locks: Dict[ActorID, asyncio.Lock] = {}
+        # Lease pool (ref: normal_task_submitter.h scheduling_key_entries_):
+        # all state touched only on the io loop thread.
+        self._sched_states: Dict[tuple, _SchedKeyState] = {}
+        self._lease_sweeper: Optional[asyncio.Task] = None
         self._shutdown_flag = False
         self._event_cursor = 0
         # Worker-role: current lease for blocked-CPU accounting.
@@ -581,7 +629,10 @@ class ClusterRuntime(BaseRuntime):
             try:
                 if sub.cancelled:
                     raise _CancelledInFlight()
-                result = await self._lease_and_push(spec, sub)
+                if self._poolable(spec):
+                    result = await self._submit_via_pool(spec, sub)
+                else:
+                    result = await self._lease_and_push(spec, sub)
             except _CancelledInFlight:
                 self._fail_returns(spec, TaskError.from_exception(
                     TaskCancelledError(
@@ -709,6 +760,257 @@ class ClusterRuntime(BaseRuntime):
             raise
         fut.set_result(wire)
         return wire
+
+    # ------------------------------------------- pooled lease submission
+    # Ref: transport/normal_task_submitter.h:74,182 — the owner keeps a
+    # per-scheduling-key task queue and a pool of granted leases; an
+    # idle leased worker takes the next queued task directly (one push
+    # RPC), a lease with no work is returned after a short keep-alive,
+    # and at most `lease_request_limit` lease requests are in flight
+    # per key (each advertising the remaining backlog for autoscaling).
+
+    @staticmethod
+    def _poolable(spec: TaskSpec) -> bool:
+        # DEFAULT-strategy tasks only: SPREAD must hit the agent per
+        # task to keep spreading, and PG/affinity-bound leases carry
+        # placement state that must not outlive one task.
+        return spec.scheduling.kind == "DEFAULT"
+
+    def _sched_key(self, spec: TaskSpec, env_key: str) -> tuple:
+        return (tuple(sorted(spec.resources.amounts.items())),
+                spec.scheduling.kind, env_key, spec.job_id.hex())
+
+    async def _submit_via_pool(self, spec: TaskSpec,
+                               sub: _Submission) -> TaskResult:
+        renv_wire = await self._runtime_env_payload(spec)
+        env_key = (renv_wire or {}).get("hash", "") if renv_wire else ""
+        key = self._sched_key(spec, env_key)
+        st = self._sched_states.get(key)
+        if st is None:
+            payload = {
+                "resources": dict(spec.resources.amounts),
+                "strategy": spec.scheduling.kind,
+                "job_id": spec.job_id.hex(),
+            }
+            if renv_wire is not None:
+                payload["runtime_env"] = renv_wire
+            st = self._sched_states[key] = _SchedKeyState(key, payload)
+        if self._lease_sweeper is None:
+            from .rpc import spawn_task
+
+            self._lease_sweeper = spawn_task(self._lease_sweep_loop())
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        st.queue.append((spec, sub, fut))
+        self._pump_key(st)
+        waiters = [asyncio.ensure_future(fut),
+                   asyncio.ensure_future(sub.cancel_event.wait())]
+        try:
+            await asyncio.wait(waiters,
+                               return_when=asyncio.FIRST_COMPLETED)
+        finally:
+            waiters[1].cancel()
+        if not fut.done():
+            # Cancelled while still queued: the pump drops the entry.
+            fut.cancel()
+            raise _CancelledInFlight()
+        return fut.result()  # re-raises push/lease errors
+
+    def _pump_key(self, st: _SchedKeyState) -> None:
+        """Assign queued tasks to idle pooled leases and top up lease
+        requests toward min(backlog, lease_request_limit)."""
+        from .rpc import spawn_task
+
+        while st.idle:
+            item = self._next_queued(st)
+            if item is None:
+                break
+            pl = st.idle.pop()
+            spawn_task(self._lease_worker_loop(st, pl, item))
+        want = min(len(st.queue), self.config.lease_request_limit)
+        while len(st.request_agents) < want:
+            rid = uuid.uuid4().hex
+            st.request_agents[rid] = self.agent_addr
+            spawn_task(self._request_pool_lease(st, rid))
+
+    def _next_queued(self, st: _SchedKeyState):
+        while st.queue:
+            spec, sub, fut = st.queue.popleft()
+            if fut.done():
+                continue
+            if sub.cancelled:
+                fut.set_exception(_CancelledInFlight())
+                continue
+            return spec, sub, fut
+        return None
+
+    async def _lease_worker_loop(self, st: _SchedKeyState,
+                                 pl: _PooledLease, item=None) -> None:
+        """Feed queued tasks to one leased worker, one at a time (ref:
+        OnWorkerIdle, normal_task_submitter.h:144)."""
+        while True:
+            if item is None:
+                item = self._next_queued(st)
+            if item is None:
+                pl.idle_since = asyncio.get_event_loop().time()
+                st.idle.append(pl)
+                return
+            spec, sub, fut = item
+            item = None
+            sub.agent_addr = pl.agent_addr
+            sub.worker_addr = pl.worker_addr
+            sub.worker_id = pl.worker_id
+            sub.pushed = True
+            try:
+                worker = await self._worker_client(pl.worker_addr)
+                reply = await worker.call("push_task", {
+                    "spec": spec, "chip_ids": pl.chip_ids,
+                    "lease_id": pl.lease_id})
+            except Exception as e:  # noqa: BLE001 — relayed to waiter
+                # Worker or its node failed mid-push: this lease is
+                # unusable.  Tell the agent (best effort) so the CPU
+                # frees even if the worker process is only wedged, and
+                # let the failed task's own retry loop resubmit.
+                st.leases.pop(pl.lease_id, None)
+                self._return_lease_async(pl, worker_failed=True)
+                if not fut.done():
+                    fut.set_exception(e)
+                self._pump_key(st)
+                return
+            if not fut.done():
+                fut.set_result(reply)
+
+    async def _request_pool_lease(self, st: _SchedKeyState,
+                                  rid: str) -> None:
+        try:
+            payload = dict(st.base_payload)
+            payload["request_id"] = rid
+            agent_addr = self.agent_addr
+            hops = 0
+            while True:
+                st.request_agents[rid] = agent_addr
+                agent = await self._agent_for(agent_addr)
+                grant = await agent.call("request_lease", payload)
+                if grant is None:
+                    raise RemoteCallError(RuntimeError(
+                        f"agent {agent_addr} returned an empty lease "
+                        f"grant"))
+                if grant.get("cancelled"):
+                    return  # queue drained; sweeper yanked the request
+                if grant.get("ok"):
+                    break
+                if grant.get("retry_at") and hops < 8:
+                    agent_addr = grant["retry_at"]
+                    hops += 1
+                    payload["no_spill"] = hops >= 4
+                    continue
+                raise RemoteCallError(ValueError(
+                    grant.get("error", "lease request failed")))
+            pl = _PooledLease(grant["lease_id"], agent_addr,
+                              grant["worker_addr"],
+                              grant.get("worker_id"),
+                              grant.get("chip_ids", []))
+            st.leases[pl.lease_id] = pl
+            pl.idle_since = asyncio.get_event_loop().time()
+            st.idle.append(pl)
+            st.request_agents.pop(rid, None)
+            self._pump_key(st)
+        except (RpcError, RemoteCallError) as e:
+            # Fail the queued tasks ONLY when this key has no other
+            # way to serve them: no pooled lease (busy ones drain the
+            # queue when they go idle) and no other in-flight request.
+            # Otherwise one hop-capped or dropped request must not
+            # take down tasks another lease would have run (the old
+            # one-lease-per-task path only failed its own task).
+            st.request_agents.pop(rid, None)
+            if st.leases or st.request_agents:
+                return
+            while st.queue:
+                _spec, _sub, fut = st.queue.popleft()
+                if not fut.done():
+                    fut.set_exception(e)
+        finally:
+            st.request_agents.pop(rid, None)
+            # A request can resolve {cancelled} in a race with a task
+            # that enqueued AFTER the sweeper fired the cancel; the
+            # pump would then never run again for this key (the
+            # sweeper only acts on empty queues).  Re-pump ONLY when
+            # nothing else can serve the queue — a busy lease drains
+            # it when it goes idle, and pumping while a failing agent
+            # is the only target would spin request/fail with no
+            # backoff.
+            if st.queue and not st.leases and not st.request_agents:
+                self._pump_key(st)
+
+    def _return_lease_async(self, pl: _PooledLease,
+                            worker_failed: bool = False) -> None:
+        from .rpc import spawn_task
+
+        async def _ret():
+            try:
+                agent = await self._agent_for(pl.agent_addr)
+                await agent.call("return_lease", {
+                    "lease_id": pl.lease_id,
+                    "worker_failed": worker_failed})
+            except (RpcError, RemoteCallError):
+                pass  # agent gone; its ledger died with it
+
+        spawn_task(_ret(), self.io.loop)
+
+    async def _lease_sweep_loop(self) -> None:
+        """Return leases idle past the keep-alive, cancel lease
+        requests whose backlog drained, and refresh the per-key
+        backlog the local agent advertises as autoscaler demand (ref:
+        lease_timeout_ms_ + CancelWorkerLeaseIfNeeded +
+        ReportWorkerBacklog in normal_task_submitter.h — backlog is a
+        periodic report per scheduling key, NOT a field frozen into a
+        queued lease request for up to an hour)."""
+        last_backlog: Dict[tuple, int] = {}
+        while not self._shutdown_flag:
+            await asyncio.sleep(0.1)
+            now = asyncio.get_event_loop().time()
+            ttl = self.config.lease_keepalive_s
+            for key, st in list(self._sched_states.items()):
+                # Queue size BEYOND in-flight lease requests (each
+                # queued request already stands for one task in the
+                # agent's demand vector).
+                backlog = max(0, len(st.queue) - len(st.request_agents))
+                if backlog != last_backlog.get(key) or backlog:
+                    last_backlog[key] = backlog
+                    try:
+                        await self._agent.notify("report_backlog", {
+                            "owner": self._runtime_id,
+                            "key": repr(key),
+                            "resources": dict(
+                                st.base_payload["resources"]),
+                            "backlog": backlog})
+                    except (RpcError, OSError):
+                        pass
+                if not st.queue:
+                    for rid, agent_addr in list(st.request_agents.items()):
+                        self._cancel_lease_request_async(rid, agent_addr)
+                    for pl in [p for p in st.idle
+                               if now - p.idle_since > ttl]:
+                        st.idle.remove(pl)
+                        st.leases.pop(pl.lease_id, None)
+                        self._return_lease_async(pl)
+                if not st.queue and not st.leases \
+                        and not st.request_agents:
+                    self._sched_states.pop(key, None)
+                    last_backlog.pop(key, None)
+
+    def _cancel_lease_request_async(self, rid: str,
+                                    agent_addr: str) -> None:
+        from .rpc import spawn_task
+
+        async def _cancel():
+            try:
+                agent = await self._agent_for(agent_addr)
+                await agent.call("cancel_lease_request",
+                                 {"request_id": rid})
+            except (RpcError, RemoteCallError):
+                pass
+
+        spawn_task(_cancel(), self.io.loop)
 
     async def _lease_and_push(self, spec: TaskSpec,
                               sub: _Submission) -> TaskResult:
@@ -888,6 +1190,47 @@ class ClusterRuntime(BaseRuntime):
                 self._release_submitted_holds(held)
 
     async def _create_actor_inner(self, spec: TaskSpec) -> None:
+        """Creation-path fault tolerance (ref: gcs_actor_manager.h:90
+        — creation failures from infrastructure (node/worker death)
+        reschedule the actor elsewhere; only user-code failures and
+        placement impossibility are terminal).  The lease+push loop
+        below retries RpcErrors with backoff long enough to outlive
+        the health-check window during which a dying node still looks
+        routable."""
+        last_err: Optional[BaseException] = None
+        for attempt in range(6):
+            if attempt:
+                # A previous attempt MAY have reached the worker right
+                # before its connection died; if the actor came up,
+                # creating a second instance would be worse than wrong.
+                try:
+                    info = await self._ctl.call(
+                        "get_actor", {"actor_id": spec.actor_id})
+                except RpcError:
+                    info = None
+                if info is not None and info.get("state") == "ALIVE":
+                    return
+                await asyncio.sleep(min(0.2 * (2 ** (attempt - 1)),
+                                        2.0))
+            try:
+                await self._create_actor_attempt(spec)
+                return
+            except RpcError as e:
+                # Infrastructure: agent/worker connection lost mid-
+                # create (a node going down) — retry on fresh routing.
+                last_err = e
+                continue
+            except (RemoteCallError, ValueError) as e:
+                last_err = e
+                break
+        try:
+            await self._ctl.call("actor_died", {
+                "actor_id": spec.actor_id, "creation_failed": True,
+                "reason": f"creation failed: {last_err}"})
+        except RpcError:
+            pass
+
+    async def _create_actor_attempt(self, spec: TaskSpec) -> None:
         try:
             await self._resolve_deps(spec)
             payload = {
@@ -933,13 +1276,10 @@ class ClusterRuntime(BaseRuntime):
                 # Worker reported the creation error to the controller
                 # already; nothing else to do owner-side.
                 pass
-        except (RpcError, RemoteCallError, ValueError) as e:
-            try:
-                await self._ctl.call("actor_died", {
-                    "actor_id": spec.actor_id, "creation_failed": True,
-                    "reason": f"creation failed: {e}"})
-            except RpcError:
-                pass
+        except RpcError:
+            raise  # infra failure: _create_actor_inner retries
+        except (RemoteCallError, ValueError):
+            raise  # terminal: user code / placement impossibility
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         oids = spec.return_object_ids()
@@ -957,7 +1297,9 @@ class ClusterRuntime(BaseRuntime):
 
     async def _actor_info(self, actor_id: ActorID,
                           wait_alive: bool = True,
-                          timeout: float = 120.0) -> Dict:
+                          timeout: Optional[float] = None) -> Dict:
+        if timeout is None:
+            timeout = self.config.actor_ready_timeout_s
         deadline = asyncio.get_event_loop().time() + timeout
         delay = 0.02
         while True:
@@ -993,9 +1335,18 @@ class ClusterRuntime(BaseRuntime):
         around in-order connection delivery)."""
         try:
             ordered = spec.max_concurrency <= 1
-            lock = self._actor_submit_locks.setdefault(
-                spec.actor_id, asyncio.Lock())
-            if ordered:
+            if ordered and spec.max_retries == 0:
+                # Pipelined fast path: the submit lock covers only
+                # dep-resolution + the frame WRITE, so wire order (and
+                # therefore worker execution order) still equals
+                # program order while replies overlap.  Retriable actor
+                # methods take the serial path below — a retry after a
+                # pipelined failure could execute behind younger calls,
+                # which the lock-across-reply path can't.
+                await self._submit_actor_pipelined(spec)
+            elif ordered:
+                lock = self._actor_submit_locks.setdefault(
+                    spec.actor_id, asyncio.Lock())
                 async with lock:
                     await self._submit_actor_inner(spec)
             else:
@@ -1003,6 +1354,58 @@ class ClusterRuntime(BaseRuntime):
         finally:
             if held:
                 self._release_submitted_holds(held)
+
+    async def _submit_actor_pipelined(self, spec: TaskSpec) -> None:
+        lock = self._actor_submit_locks.setdefault(
+            spec.actor_id, asyncio.Lock())
+        fut = None
+        worker = None
+        async with lock:
+            try:
+                await self._resolve_deps(spec)
+            except TaskError as e:
+                self._fail_returns(spec, e)
+                return
+            try:
+                info = await self._actor_info(spec.actor_id)
+            except ActorDiedError as e:
+                self._fail_returns(spec, ActorError.from_exception(e))
+                return
+            try:
+                worker = await self._worker_client(info["worker_addr"])
+                fut = worker.call_nowait("push_actor_task", {
+                    "spec": spec, "caller_id": self._runtime_id})
+            except RpcError:
+                fut = None  # dial failed: serial path refreshes state
+            if fut is None:
+                self._actor_cache.pop(spec.actor_id, None)
+                await self._submit_actor_inner(spec)
+                return
+        await worker.drain()
+        try:
+            reply = await fut
+        except RpcError:
+            # Connection died with the call in flight.  No retry budget
+            # on this path (max_retries == 0): resolve to death/loss the
+            # way the serial path's no-budget branch does.
+            self._actor_cache.pop(spec.actor_id, None)
+            try:
+                await self._actor_info(spec.actor_id, timeout=5.0)
+                reason = "actor task connection lost mid-call"
+            except ActorDiedError as de:
+                reason = str(de.reason)
+            self._fail_returns(spec, ActorError.from_exception(
+                ActorDiedError(spec.actor_id.hex(), reason)))
+            return
+        except RemoteCallError as e:
+            self._fail_returns(spec, ActorError.from_exception(e.cause))
+            return
+        if not reply.ok:
+            err = reply.error
+            self._fail_returns(spec, err if isinstance(err, TaskError)
+                               else ActorError.from_exception(err))
+            return
+        self._accept_returns(spec, reply)
 
     async def _submit_actor_inner(self, spec: TaskSpec) -> None:
         try:
@@ -1156,10 +1559,34 @@ class ClusterRuntime(BaseRuntime):
                                            {"object_id": oid})
             except RpcError:
                 loc = None
-            if not (loc and loc["nodes"]):
-                if not await self._reconstruct_object(oid):
-                    return False
+            # The directory lags node death by the health-check window
+            # (its "alive" filter is heartbeat-based), so a listed copy
+            # may be on a node that is already gone — trusting it here
+            # is exactly the round-3/4 interleaving that marked a
+            # reconstructable object unreconstructable.  Confirm a
+            # listed copy actually answers before believing it.
+            confirmed = False
+            for ent in (loc or {}).get("nodes") or []:
+                try:
+                    agent = await self._agent_for(ent["agent_addr"])
+                    r = await asyncio.wait_for(
+                        agent.call("objects_exist",
+                                   {"object_ids": [oid]}), 3.0)
+                    if r.get(oid):
+                        confirmed = True
+                        break
+                except (RpcError, RemoteCallError,
+                        asyncio.TimeoutError, OSError):
+                    continue
+            if confirmed:
+                # A live copy exists: the executor's pull failure was
+                # transient (e.g. raced a spill or the pull targeted a
+                # dying node) — retrying the task is enough.
                 recovered = True
+                continue
+            if not await self._reconstruct_object(oid):
+                return False
+            recovered = True
         return recovered
 
     async def _reconstruct_object(self, oid: ObjectID,
@@ -1462,6 +1889,12 @@ class ClusterRuntime(BaseRuntime):
     # ------------------------------------------------------------ shutdown
     def shutdown(self) -> None:
         self._shutdown_flag = True
+        try:
+            # Give cached leases back so a departing driver doesn't pin
+            # CPUs on a shared cluster until the keep-alive would expire.
+            self.io.run(self._release_pooled_leases(), timeout=5.0)
+        except Exception:
+            pass
         if self._registered_job_int is not None and not self._owns_head:
             # A departing driver finishes its job so the controller
             # reaps its non-detached actors — a connect/disconnect
@@ -1493,6 +1926,22 @@ class ClusterRuntime(BaseRuntime):
                         pass
             if self._owns_head:
                 self._cleanup_shm()
+
+    async def _release_pooled_leases(self) -> None:
+        for st in list(self._sched_states.values()):
+            for rid, agent_addr in list(st.request_agents.items()):
+                self._cancel_lease_request_async(rid, agent_addr)
+            for pl in list(st.leases.values()):
+                try:
+                    agent = await self._agent_for(pl.agent_addr)
+                    await asyncio.wait_for(
+                        agent.call("return_lease",
+                                   {"lease_id": pl.lease_id}), 2.0)
+                except Exception:
+                    pass
+            st.leases.clear()
+            st.idle.clear()
+        self._sched_states.clear()
 
     def _cleanup_shm(self) -> None:
         shm_dir = "/dev/shm"

@@ -132,6 +132,22 @@ define_flag("autoscaling_enabled", bool, False,
 define_flag("runtime_env_cache_bytes", int, 2 * 1024**3,
             "LRU cap on runtime-env package blobs held in controller "
             "memory; least-recently-used packages are evicted beyond it.")
+define_flag("actor_ready_timeout_s", float, 120.0,
+            "How long callers wait for a PENDING/RESTARTING actor to "
+            "become ALIVE before failing the call (many concurrent "
+            "actor creations on a loaded host need more than the "
+            "default).")
+define_flag("lease_keepalive_s", float, 0.5,
+            "How long an owner keeps a granted-but-idle worker lease "
+            "cached for reuse by the next same-shaped task before "
+            "returning it to the node agent (ref: "
+            "normal_task_submitter.h:74 lease_timeout_ms_ — lease "
+            "reuse removes the per-task lease round-trip).")
+define_flag("lease_request_limit", int, 10,
+            "Max concurrent outstanding lease requests per scheduling "
+            "key (resource shape + runtime env) per owner (ref: "
+            "StaticLeaseRequestRateLimiter in "
+            "normal_task_submitter.h).")
 # TPU-specific flags.
 define_flag("tpu_chips_per_host", int, 0,
             "Override detected TPU chip count (0 = autodetect).")

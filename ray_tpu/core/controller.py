@@ -300,6 +300,14 @@ class Controller:
         if actor.state == DEAD:
             # Killed while still starting; tell the worker to exit.
             return {"ok": False, "kill": True}
+        if actor.state == ALIVE and actor.worker_addr and \
+                actor.worker_addr != p["worker_addr"]:
+            # First registration wins (ref: gcs_actor_manager single-
+            # instance invariant): a duplicate creation attempt — the
+            # owner retried after a transient connection loss while
+            # the first attempt's __init__ was still running — must
+            # exit instead of clobbering the live instance's address.
+            return {"ok": False, "kill": True}
         actor.state = ALIVE
         actor.node_id = p["node_id"]
         actor.worker_addr = p["worker_addr"]

@@ -144,7 +144,7 @@ class NodeAgent:
             "cancel_lease_request",
             "register_worker", "worker_heartbeat",
             "report_task_events", "report_metrics",
-            "task_blocked", "task_unblocked",
+            "task_blocked", "task_unblocked", "report_backlog",
             "register_object", "pull_object", "fetch_raw", "fetch_chunk",
             "delete_object", "make_room",
             "object_exists", "objects_exist", "store_stats",
@@ -237,8 +237,14 @@ class NodeAgent:
                 now = time.time()
                 if self.leases or self.bundles:
                     self._last_busy = now
+                # Demand = queued lease requests + owner-reported
+                # backlogs (lease requests are rate-limited per owner,
+                # so queued tasks beyond the in-flight requests arrive
+                # via report_backlog; ref: ReportWorkerBacklog in
+                # normal_task_submitter.h).
                 demands = [dict(req.payload["resources"])
                            for req in self.pending][:100]
+                demands += self._backlog_demands()
                 demands += list(getattr(self, "_infeasible", []))[:100]
                 r = await self._ctl.call("heartbeat", {
                     "node_id": self.node_id,
@@ -421,9 +427,12 @@ class NodeAgent:
         # (file-locked, off this event loop) and execs worker_main
         # under the venv python (ref: _private/runtime_env/pip.py —
         # the worker STARTS inside its environment).
-        module = ("ray_tpu.runtime_env.pip_bootstrap"
-                  if runtime_env and runtime_env.get("pip")
-                  else "ray_tpu.core.worker_main")
+        if runtime_env and runtime_env.get("pip"):
+            module = "ray_tpu.runtime_env.pip_bootstrap"
+        elif runtime_env and runtime_env.get("uv"):
+            module = "ray_tpu.runtime_env.uv_bootstrap"
+        else:
+            module = "ray_tpu.core.worker_main"
         proc = subprocess.Popen(
             [sys.executable, "-u", "-m", module],
             env=env, stdout=out, stderr=subprocess.STDOUT,
@@ -462,6 +471,36 @@ class NodeAgent:
 
     async def worker_heartbeat(self, p):
         return {"ok": True}
+
+    async def report_backlog(self, p):
+        """Owner-side per-scheduling-key backlog report (notify; ref:
+        ReportWorkerBacklog in normal_task_submitter.h) — folded into
+        the heartbeat's demand vector with a freshness TTL so demand
+        from a dead owner ages out."""
+        backlogs = getattr(self, "_owner_backlogs", None)
+        if backlogs is None:
+            backlogs = self._owner_backlogs = {}
+        key = (p.get("owner"), p.get("key"))
+        if not p.get("backlog"):
+            backlogs.pop(key, None)
+        else:
+            backlogs[key] = (dict(p["resources"]),
+                             int(p["backlog"]), time.time())
+        return {"ok": True}
+
+    def _backlog_demands(self, cap: int = 100):
+        """Fresh owner backlogs as a demand list for the autoscaler."""
+        backlogs = getattr(self, "_owner_backlogs", {})
+        now = time.time()
+        out = []
+        for key, (res, n, ts) in list(backlogs.items()):
+            if now - ts > 5.0:
+                backlogs.pop(key, None)
+                continue
+            out.extend([dict(res)] * min(n, 20))
+            if len(out) >= cap:
+                break
+        return out[:cap]
 
     async def report_task_events(self, p):
         """Relay worker task events to the controller sink (workers have
@@ -896,7 +935,24 @@ class NodeAgent:
     async def return_lease(self, p):
         lease = self.leases.get(p["lease_id"])
         if lease is not None:
-            self._release_lease(lease)
+            if p.get("worker_failed"):
+                # The owner's push to this worker failed: free the
+                # resources but do NOT recycle the worker — kill it so
+                # the reap loop confirms death (a wedged-but-alive
+                # worker must not re-enter the idle pool).
+                self._release_lease(lease, worker_back=False)
+                w = lease.worker
+                w.state = "dead"
+                self.workers.pop(w.worker_id, None)
+                try:
+                    if w.proc is not None:
+                        w.proc.kill()
+                    else:
+                        os.kill(w.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            else:
+                self._release_lease(lease)
         return {"ok": True}
 
     async def lease_status(self, p):

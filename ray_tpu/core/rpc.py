@@ -328,6 +328,35 @@ class RpcClient:
             return await asyncio.wait_for(fut, timeout)
         return await fut
 
+    def call_nowait(self, method: str, payload: Any = None
+                    ) -> "asyncio.Future":
+        """Write a request frame synchronously and return the response
+        future.  Unlike ``call``, this never suspends before the write,
+        so N ``call_nowait``s made in order put N frames on the wire in
+        that order — the guarantee pipelined ordered-actor submission
+        is built on (the peer dispatches frames in arrival order).
+        Caller must already be connected (``await connect()``)."""
+        if self._writer is None:
+            raise RpcError(f"not connected to {self.address}")
+        req_id = next(self._req_counter)
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[req_id] = fut
+        try:
+            self._writer.write(
+                _encode_frame((_REQUEST, req_id, method, payload)))
+        except (ConnectionError, OSError) as e:
+            self._pending.pop(req_id, None)
+            raise RpcError(f"send to {self.address} failed: {e}") from e
+        return fut
+
+    async def drain(self) -> None:
+        """Apply transport backpressure after call_nowait bursts."""
+        if self._writer is not None:
+            try:
+                await self._writer.drain()
+            except (ConnectionError, OSError):
+                pass  # the pending futures surface the failure
+
     async def notify(self, method: str, payload: Any = None) -> None:
         if self._writer is None:
             await self.connect()

@@ -415,6 +415,13 @@ class Worker:
         self.actor_executor = ThreadPoolExecutor(
             max_workers=n, thread_name_prefix="actor-exec")
         self._actor_max_concurrency = n
+        # max_concurrency=1: owners PIPELINE calls (frames arrive before
+        # earlier replies are sent), so ordering must be enforced here —
+        # one FIFO lock serializing sync and async methods in arrival
+        # order (asyncio.Lock wakes waiters FIFO; handler tasks start in
+        # frame-arrival order).  Ref: ActorSchedulingQueue in
+        # transport/task_receiver.h executing in sequence-number order.
+        self._actor_exec_lock = asyncio.Lock() if n == 1 else None
         ctl = RpcClient(self.controller_addr,
                         tag=f"actor-{spec.actor_id.hex()[:8]}")
         await ctl.connect()
@@ -444,10 +451,15 @@ class Worker:
                 task_id=spec.task_id, ok=False,
                 error=ActorError.from_exception(AttributeError(
                     f"actor has no method {spec.method_name!r}")))
-        # Ordering: owners serialize max_concurrency=1 submissions, frames
-        # arrive in order per connection, and handler tasks + the actor
-        # executor are FIFO — so arrival order IS execution order here.
         del caller
+        lock = getattr(self, "_actor_exec_lock", None)
+        if lock is not None:
+            async with lock:
+                return await self._run_actor_method(spec, method)
+        return await self._run_actor_method(spec, method)
+
+    async def _run_actor_method(self, spec: TaskSpec, method
+                                ) -> TaskResult:
         if inspect.iscoroutinefunction(method):
             return await self._run_async_method(spec, method)
         loop = asyncio.get_event_loop()
