@@ -18,6 +18,9 @@ from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
                     Tuple, Union)
 
 from .block import Block, BlockAccessor, build_block
+from .logical import (LogicalOp, barrier_op, limit_op, map_op,
+                      read_op, union_op, zip_op)
+from . import logical as _logical
 
 
 @dataclass
@@ -72,6 +75,50 @@ class _RefSource:
         import ray_tpu
 
         return ray_tpu.get(self.ref)
+
+
+class _BoundSource:
+    """Source thunk with an op chain fused in — the splice that makes
+    union/zip ZERO-task plan surgery: each input keeps its own ops and
+    the downstream stage's ops apply on top, all inside one task per
+    block (ref: operator_fusion.py:41 — fusion across the union)."""
+
+    def __init__(self, source: Callable, ops: List["_Op"]):
+        self.source = source
+        self.ops = list(ops)
+
+    def __call__(self) -> Block:
+        return _apply_ops(self.source(), self.ops)
+
+
+def _zip_rows(a: Any, b: Any) -> Any:
+    if isinstance(a, dict) and isinstance(b, dict):
+        out = dict(a)
+        for k, v in b.items():
+            out[k if k not in out else f"{k}_1"] = v
+        return out
+    return (a, b)
+
+
+class _PairSource:
+    """Zip two aligned block thunks into one block of merged rows
+    (ref: dataset.py:2543 zip — dict rows merge with right-side
+    suffixing, other rows pair into tuples)."""
+
+    def __init__(self, left: Callable, right: Callable):
+        self.left = left
+        self.right = right
+
+    def __call__(self) -> Block:
+        la = BlockAccessor.for_block(self.left())
+        ra = BlockAccessor.for_block(self.right())
+        if la.num_rows() != ra.num_rows():
+            raise ValueError(
+                f"zip: misaligned blocks ({la.num_rows()} vs "
+                f"{ra.num_rows()} rows) — repartition() both sides "
+                f"to the same block layout first")
+        return build_block([_zip_rows(a, b) for a, b in
+                            zip(la.iter_rows(), ra.iter_rows())])
 
 
 # ---------------------------------------------------- shuffle task bodies
@@ -238,15 +285,28 @@ class Dataset:
 
     def __init__(self, sources: List[Callable[[], Block]],
                  ops: Optional[List[_Op]] = None,
-                 parallel_window: int = 4):
+                 parallel_window: int = 4,
+                 plan: Optional[LogicalOp] = None,
+                 limit: Optional[int] = None):
         self._sources = sources
         self._ops = list(ops or [])
         self._window = parallel_window
         self._materialized: Optional[List[Block]] = None
+        self._plan = plan or read_op(len(sources))
+        self._limit = limit
 
     # --------------------------------------------------------- transforms
     def _with_op(self, op: _Op) -> "Dataset":
-        return Dataset(self._sources, self._ops + [op], self._window)
+        base = self
+        if self._limit is not None:
+            # A limit is a streaming stage boundary: close it (execute
+            # up to n rows) before stacking more operators.  The
+            # reference keeps this lazy through its planner; here the
+            # boundary materializes refs (bounded by the limit).
+            base = self._freeze_limit()
+        node = map_op(op.kind, op.fn, base._plan)
+        return Dataset(base._sources, base._ops + [op], base._window,
+                       plan=node)
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
         return self._with_op(_Op("map", fn))
@@ -261,6 +321,64 @@ class Dataset:
                     batch_size: Optional[int] = None) -> "Dataset":
         return self._with_op(_Op("map_batches", fn, batch_size,
                                  batch_format))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets block-wise — ZERO tasks of its own:
+        each side keeps its own fused op chain inside its source
+        thunks (ref: dataset.py:2052 union)."""
+        parts = [self] + [o for o in others]
+        sources: List[Callable[[], Block]] = []
+        plans = []
+        for d in parts:
+            if d._limit is not None:
+                d = d._freeze_limit()
+            sources.extend(
+                [_BoundSource(src, d._ops) for src in d._sources]
+                if d._ops else list(d._sources))
+            plans.append(d._plan)
+        return Dataset(sources, [], self._window,
+                       plan=union_op(plans))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Pair rows of two datasets with identical block layouts into
+        merged rows — one task per block PAIR, both sides' op chains
+        fused into it (ref: dataset.py:2543 zip)."""
+        left = self._freeze_limit() if self._limit is not None else self
+        right = other._freeze_limit() if other._limit is not None             else other
+        if len(left._sources) != len(right._sources):
+            raise ValueError(
+                f"zip: block counts differ ({len(left._sources)} vs "
+                f"{len(right._sources)}); repartition() first")
+        sources = [
+            _PairSource(
+                _BoundSource(l, left._ops) if left._ops else l,
+                _BoundSource(r, right._ops) if right._ops else r)
+            for l, r in zip(left._sources, right._sources)]
+        return Dataset(sources, [], self._window,
+                       plan=zip_op(left._plan, right._plan))
+
+    def limit(self, n: int) -> "Dataset":
+        """First n rows, streaming: execution stops launching block
+        tasks once n rows have materialized and truncates the final
+        block (ref: Limit operator in the streaming executor)."""
+        if n < 0:
+            raise ValueError("limit must be >= 0")
+        d = Dataset(self._sources, self._ops, self._window,
+                    plan=limit_op(self._plan, n), limit=n)
+        d._materialized = self._materialized
+        return d
+
+    def _freeze_limit(self) -> "Dataset":
+        refs = self._to_block_refs()
+        d = Dataset._from_refs(refs, self._window)
+        d._plan = self._plan
+        return d
+
+    def explain(self) -> str:
+        """Logical plan + physical stages after fusion (ref: the
+        logical-plan `explain` surface; tests assert the fused task
+        count from this)."""
+        return _logical.explain(self._plan)
 
     # ---------------------------------------------------------- execution
     def num_blocks(self) -> int:
@@ -330,6 +448,30 @@ class Dataset:
             yield ("ref", head)
 
     def _iter_blocks(self) -> Iterator[Block]:
+        it = self._iter_blocks_unlimited()
+        if self._limit is None:
+            yield from it
+            return
+        # Streaming early-stop: stop consuming (and therefore stop
+        # launching) once n rows are out; truncate the final block.
+        remaining = self._limit
+        for block in it:
+            if remaining <= 0:
+                return
+            acc = BlockAccessor.for_block(block)
+            rows = acc.num_rows()
+            if rows <= remaining:
+                remaining -= rows
+                yield block
+            else:
+                yield build_block(
+                    [r for _, r in zip(range(remaining),
+                                       acc.iter_rows())])
+                remaining = 0
+            if remaining <= 0:
+                return
+
+    def _iter_blocks_unlimited(self) -> Iterator[Block]:
         import ray_tpu
         from ..core import runtime as _rt
 
@@ -432,6 +574,8 @@ class Dataset:
         already-materialized dataset are put once."""
         import ray_tpu
 
+        if self._limit is not None:
+            return [ray_tpu.put(b) for b in self._iter_blocks()]
         refs = []
         for kind, item in self._execute_refs():
             refs.append(item if kind == "ref" else ray_tpu.put(item))
@@ -449,7 +593,10 @@ class Dataset:
         independently (the reference's streaming_split; nothing
         materializes on the driver).  Otherwise blocks are counted and
         re-sliced at row granularity by remote tasks (driver-free)."""
-        if self._materialized is None and len(self._sources) >= n \
+        if self._limit is not None and self._has_runtime():
+            return self._freeze_limit().split(n, equal=equal)
+        if self._materialized is None and self._limit is None \
+                and len(self._sources) >= n \
                 and len(self._sources) % n == 0:
             per = len(self._sources) // n
             return [Dataset(self._sources[i * per:(i + 1) * per],
@@ -585,7 +732,9 @@ class Dataset:
             [lambda j=j: reduce_call(j, map_out)
              for j in range(n_out)],
             probe=lambda r: r)
-        return Dataset._from_refs(reduce_refs, self._window)
+        out = Dataset._from_refs(reduce_refs, self._window)
+        out._plan = barrier_op(self._plan, "shuffle", n_out)
+        return out
 
     def _exchange(self, n_out: int, assign: str, do_shuffle: bool,
                   seed: Optional[int],
@@ -594,6 +743,13 @@ class Dataset:
                   sort_spec: Optional[Tuple[Any, bool]] = None
                   ) -> "Dataset":
         """Two-stage map/reduce exchange through the object plane."""
+        if self._limit is not None:
+            # A limit is a stage boundary: materialize the limited
+            # prefix first, then exchange it — otherwise the exchange
+            # would read the UNLIMITED sources (wrong results).
+            return self._freeze_limit()._exchange(
+                n_out, assign, do_shuffle, seed, key_spec=key_spec,
+                boundaries=boundaries, sort_spec=sort_spec)
         import ray_tpu
 
         map_fn = ray_tpu.remote(_shuffle_map).options(
@@ -683,6 +839,8 @@ class Dataset:
         """Group rows by key column (or key function); aggregate with
         .count()/.sum()/.mean()/... or .map_groups() (ref:
         python/ray/data/grouped_data.py GroupedData)."""
+        if self._limit is not None and self._has_runtime():
+            return self._freeze_limit().groupby(key)
         from .grouped_data import GroupedData
 
         return GroupedData(self, key)
@@ -694,6 +852,8 @@ class Dataset:
         if not aggs:
             raise ValueError("aggregate() needs at least one "
                              "AggregateFn")
+        if self._limit is not None and self._has_runtime():
+            return self._freeze_limit().aggregate(*aggs)
         if self._has_runtime():
             import ray_tpu
             from ..core import serialization
