@@ -22,4 +22,8 @@ from .env_runner import EnvRunnerGroup, SingleAgentEnvRunner  # noqa
 from .impala import (IMPALA, Aggregator, ImpalaJaxLearner,  # noqa
                      IMPALAConfig, VTraceConfig)
 from .learner import LearnerGroup, PPOConfig, PPOJaxLearner  # noqa
+from .multi_agent import (MultiAgentConfig, MultiAgentEnv,  # noqa
+                          MultiAgentEnvRunner,
+                          MultiAgentEnvRunnerGroup, MultiAgentPPO,
+                          MultiJaxRLModule, MultiRLModuleSpec)
 from .rl_module import JaxRLModule, RLModuleSpec  # noqa: F401

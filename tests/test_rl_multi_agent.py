@@ -1,0 +1,126 @@
+"""Multi-agent RL: env protocol, module routing, and PPO self-play
+where BOTH policies' returns improve (VERDICT r4 #5; ref:
+rllib/env/multi_agent_env.py:29, core/rl_module/multi_rl_module.py:49).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import (MultiAgentConfig, MultiAgentEnv,
+                        MultiAgentEnvRunner, MultiRLModuleSpec,
+                        RLModuleSpec)
+
+
+class TwoAgentBandit(MultiAgentEnv):
+    """Two contextual bandits sharing one env: each agent sees its own
+    one-hot context and earns 1 for matching the context index, plus a
+    cooperation bonus when both match — so each policy's return
+    improves only by actually learning its mapping."""
+
+    possible_agents = ["a0", "a1"]
+    CONTEXTS = 4
+    EP_LEN = 8
+
+    def __init__(self, seed=0):
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._ctx = {}
+
+    def _draw(self):
+        self._ctx = {
+            aid: int(self._rng.integers(self.CONTEXTS))
+            for aid in self.possible_agents}
+        return {aid: np.eye(self.CONTEXTS, dtype=np.float32)[c]
+                for aid, c in self._ctx.items()}
+
+    def reset(self, *, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        return self._draw(), {}
+
+    def step(self, actions):
+        hits = {aid: float(int(actions[aid]) == self._ctx[aid])
+                for aid in self.possible_agents}
+        bonus = 0.5 if all(hits.values()) else 0.0
+        rewards = {aid: h + bonus for aid, h in hits.items()}
+        self._t += 1
+        done = self._t >= self.EP_LEN
+        obs = self._draw()
+        dones = {"__all__": done}
+        return obs, rewards, dones, {"__all__": False}, {}
+
+
+def _specs():
+    s = RLModuleSpec(observation_dim=TwoAgentBandit.CONTEXTS,
+                     action_dim=TwoAgentBandit.CONTEXTS, hidden=(32,))
+    return {"p0": s, "p1": s}
+
+
+def test_multi_agent_runner_routes_per_module():
+    """Each module's panel has exactly its agents' slots, and batches
+    are [T, slots] shaped."""
+    runner = MultiAgentEnvRunner(
+        TwoAgentBandit, MultiRLModuleSpec(_specs()),
+        policy_mapping_fn=lambda aid: "p0" if aid == "a0" else "p1",
+        num_envs=3, seed=0)
+    import jax
+
+    params = runner.multi.init(jax.random.PRNGKey(0))
+    runner.set_weights(params)
+    out = runner.sample(num_steps=5)
+    assert set(out) == {"p0", "p1"}
+    for mid in ("p0", "p1"):
+        assert out[mid]["obs"].shape == (5, 3, TwoAgentBandit.CONTEXTS)
+        assert out[mid]["actions"].shape == (5, 3)
+        assert out[mid]["rewards"].dtype == np.float32
+
+
+def test_multi_agent_shared_policy_mapping():
+    """Both agents can map onto ONE shared module: its panel then has
+    2 x num_envs slots (ref: shared-policy mapping in multi_agent())."""
+    runner = MultiAgentEnvRunner(
+        TwoAgentBandit, MultiRLModuleSpec({"shared": _specs()["p0"]}),
+        policy_mapping_fn=lambda aid: "shared", num_envs=2, seed=0)
+    import jax
+
+    runner.set_weights(runner.multi.init(jax.random.PRNGKey(0)))
+    out = runner.sample(num_steps=4)
+    assert set(out) == {"shared"}
+    assert out["shared"]["obs"].shape == (4, 4, TwoAgentBandit.CONTEXTS)
+
+
+def test_multi_agent_ppo_both_policies_improve(tmp_path):
+    """Self-play PPO on the two-agent bandit: BOTH policies' mean
+    episode returns must improve from their first-iteration level
+    (VERDICT r4 #5 done-bar)."""
+    ray_tpu.init(mode="local")
+    try:
+        algo = (MultiAgentConfig()
+                .environment(TwoAgentBandit)
+                .multi_agent(policies=_specs(),
+                             policy_mapping_fn=lambda aid:
+                             "p0" if aid == "a0" else "p1")
+                .env_runners(num_env_runners=1, num_envs_per_runner=4,
+                             rollout_length=64)
+                .training(lr=3e-3, entropy_coeff=0.0,
+                          minibatch_size=128, num_epochs=4)
+                .build())
+        first, last = None, None
+        for _ in range(12):
+            last = algo.train()
+            if first is None and \
+                    "episode_return_mean/a0" in last:
+                first = dict(last)
+        algo.stop()
+        # Random play: P(hit)=0.25 -> return ~= 8*(0.25+0.5*0.0625)
+        # ~= 2.25.  Learned play approaches 8*1.5 = 12.
+        assert last["episode_return_mean/a0"] > \
+            first["episode_return_mean/a0"] + 1.0, (first, last)
+        assert last["episode_return_mean/a1"] > \
+            first["episode_return_mean/a1"] + 1.0, (first, last)
+        assert last["episode_return_mean/a0"] > 5.0
+        assert last["episode_return_mean/a1"] > 5.0
+    finally:
+        ray_tpu.shutdown()
