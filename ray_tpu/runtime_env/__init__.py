@@ -44,7 +44,7 @@ def normalize(runtime_env: Optional[Dict[str, Any]]
     """Validate + canonicalize a user-supplied runtime_env dict."""
     if not runtime_env:
         return None
-    allowed = {"env_vars", "working_dir", "py_modules", "pip"}
+    allowed = {"env_vars", "working_dir", "py_modules", "pip", "uv"}
     unknown = set(runtime_env) - allowed
     if unknown:
         raise ValueError(
@@ -68,10 +68,19 @@ def normalize(runtime_env: Optional[Dict[str, Any]]
         if not os.path.isdir(wd):
             raise ValueError(f"working_dir {wd!r} is not a directory")
         out["working_dir"] = wd
+    if runtime_env.get("pip") and runtime_env.get("uv"):
+        raise ValueError(
+            "runtime_env cannot set both 'pip' and 'uv' — pick one "
+            "installer for the env (ref: runtime_env plugin "
+            "exclusivity in _private/runtime_env/uv.py)")
     if runtime_env.get("pip"):
         from .pip import normalize_pip
 
         out["pip"] = normalize_pip(runtime_env["pip"])
+    if runtime_env.get("uv"):
+        from .uv import normalize_uv
+
+        out["uv"] = normalize_uv(runtime_env["uv"])
     mods = runtime_env.get("py_modules") or []
     if mods:
         norm = []
@@ -131,6 +140,8 @@ def package(env: Dict[str, Any]
         # Requirements travel in the spec (tiny); the venv builds on
         # each node at first use, cached by requirement hash.
         spec["pip"] = list(env["pip"])
+    if env.get("uv"):
+        spec["uv"] = list(env["uv"])
     if env.get("working_dir"):
         spec["working_dir_pkg"] = pack(env["working_dir"])
     if env.get("py_modules"):

@@ -1,0 +1,126 @@
+"""uv runtime-env plugin — hash-keyed cached venvs built with uv.
+
+Role-equivalent to the reference's uv plugin (ref:
+python/ray/_private/runtime_env/uv.py — same shape as pip.py but the
+resolver/installer is the uv binary, ~10-100x faster for cached
+wheels).  Identical contract to our pip plugin (pip.py): the worker
+STARTS inside the env via a bootstrap trampoline, venvs are keyed by
+(requirements, python version) and shared across workers under a file
+lock, and the cluster stack (jax/libtpu/flax) is inherited through
+system-site-packages.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+from typing import List
+
+from .pip import _OK_MARKER, _venv_python, normalize_pip
+
+normalize_uv = normalize_pip  # same two spellings, same ordering rule
+
+
+def uv_available() -> bool:
+    return shutil.which("uv") is not None
+
+
+def venv_key(packages: List[str]) -> str:
+    payload = json.dumps(
+        {"reqs": list(packages), "py": sys.version_info[:2],
+         "tool": "uv"}, sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def ensure_uv_venv(packages: List[str], cache_root: str,
+                   log=None) -> str:
+    """Build (or reuse) a uv-managed venv; returns its python path.
+    Concurrent-safe via flock, like pip.ensure_venv."""
+    import fcntl
+
+    packages = normalize_uv(packages)
+    if not uv_available():
+        raise RuntimeError(
+            "runtime_env['uv'] requested but no `uv` binary is on "
+            "PATH on this node")
+    key = venv_key(packages)
+    os.makedirs(cache_root, exist_ok=True)
+    venv_dir = os.path.join(cache_root, f"uv-{key}")
+    marker = os.path.join(venv_dir, _OK_MARKER)
+    if os.path.exists(marker):
+        return _venv_python(venv_dir)
+    lock_path = os.path.join(cache_root, f"uv-{key}.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if os.path.exists(marker):
+            return _venv_python(venv_dir)
+        if log:
+            log(f"building uv venv {key} for {packages}")
+        tmp = f"{venv_dir}.tmp.{os.getpid()}"
+        proc = subprocess.run(
+            ["uv", "venv", "--system-site-packages",
+             "--python", sys.executable, tmp],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"uv venv failed:\n{proc.stderr[-2000:]}")
+        # Same parent-site .pth bridge as pip.py: when the cluster
+        # python is itself a venv, its site-packages must stay
+        # importable beneath the new env's own installs.
+        import glob as _glob
+
+        venv_site = _glob.glob(os.path.join(
+            tmp, "lib", "python*", "site-packages"))[0]
+        parent_sites = [p for p in sys.path
+                        if p.endswith("site-packages")
+                        and os.path.isdir(p)]
+        if parent_sites:
+            with open(os.path.join(venv_site,
+                                   "_rt_parent_site.pth"), "w") as f:
+                f.write("\n".join(parent_sites) + "\n")
+        if any(not x.startswith("-") for x in packages):
+            proc = subprocess.run(
+                ["uv", "pip", "install",
+                 "--python", _venv_python(tmp), *packages],
+                capture_output=True, text=True)
+            if proc.returncode != 0:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise RuntimeError(
+                    f"uv pip install failed for {packages}:\n"
+                    f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+        if os.path.isdir(venv_dir):
+            shutil.rmtree(venv_dir, ignore_errors=True)
+        os.replace(tmp, venv_dir)
+        with open(marker, "w") as f:
+            f.write("\n".join(packages))
+        return _venv_python(venv_dir)
+
+
+def bootstrap_main() -> int:
+    """Agent-spawned trampoline (``python -m
+    ray_tpu.runtime_env.uv_bootstrap``): land the worker inside its
+    uv venv; a failed build poisons the worker via
+    RT_RUNTIME_ENV_ERROR instead of exiting (see pip.bootstrap_main
+    for why)."""
+    spec = json.loads(os.environ.get("RT_RUNTIME_ENV", "{}"))
+    packages = spec.get("uv") or []
+    from ray_tpu.core.config import RuntimeConfig
+
+    cfg = RuntimeConfig.from_env()
+    cache_root = os.path.join(
+        cfg.session_dir_root,
+        os.environ.get("RT_SESSION_NAME", "default"), "uv_envs")
+    try:
+        python = ensure_uv_venv(packages, cache_root,
+                                log=lambda m: print(m, flush=True))
+    except Exception as e:  # noqa: BLE001 — poisoned worker reports it
+        print(f"uv env build failed: {e!r}", flush=True)
+        os.environ["RT_RUNTIME_ENV_ERROR"] = \
+            f"uv env build failed: {e}"[:2000]
+        python = sys.executable
+    os.execv(python, [python, "-u", "-m", "ray_tpu.core.worker_main"])
+    return 0  # unreachable

@@ -1,0 +1,9 @@
+"""Trampoline: build/reuse the env's uv venv, then exec worker_main
+inside it (see uv.py; ref: _private/runtime_env/uv.py)."""
+
+import sys
+
+from .uv import bootstrap_main
+
+if __name__ == "__main__":
+    sys.exit(bootstrap_main())

@@ -1,0 +1,90 @@
+"""uv runtime envs: hash-keyed cached uv venvs the worker starts
+inside — the uv twin of the pip plugin tests (VERDICT r4 #10; ref:
+python/ray/_private/runtime_env/uv.py)."""
+
+import shutil
+
+import pytest
+
+import ray_tpu
+from ray_tpu import runtime_env as renv
+
+needs_uv = pytest.mark.skipif(shutil.which("uv") is None,
+                              reason="no uv binary on PATH")
+
+
+@pytest.fixture
+def cluster_rt():
+    rt = ray_tpu.init(mode="cluster", num_cpus=1)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_uv_normalization():
+    assert renv.normalize({"uv": ["b", "a"]}) == {"uv": ["b", "a"]}
+    assert renv.normalize(
+        {"uv": {"packages": ["x"]}}) == {"uv": ["x"]}
+    with pytest.raises(TypeError):
+        renv.normalize({"uv": "requests"})
+    with pytest.raises(ValueError):
+        renv.normalize({"uv": ["x"], "pip": ["y"]})
+
+
+@needs_uv
+def test_uv_runtime_env_worker_in_venv(cluster_rt, tmp_path):
+    """A task with a uv requirement the cluster python LACKS runs
+    inside a hash-keyed cached uv venv that has it.  Hermetic: the
+    requirement is a local package installed with --no-index."""
+    pkg = tmp_path / "uvdep"
+    (pkg / "uvdep").mkdir(parents=True)
+    (pkg / "uvdep" / "__init__.py").write_text("VALUE = 7\n")
+    (pkg / "pyproject.toml").write_text(
+        '[build-system]\nrequires = ["setuptools"]\n'
+        'build-backend = "setuptools.build_meta"\n'
+        '[project]\nname = "uvdep"\nversion = "0.1.0"\n'
+        '[tool.setuptools]\npackages = ["uvdep"]\n')
+    reqs = ["--no-index", "--no-build-isolation", str(pkg)]
+
+    @ray_tpu.remote(runtime_env={"uv": reqs})
+    def use_dep():
+        import sys
+
+        import uvdep
+
+        return uvdep.VALUE, sys.executable
+
+    @ray_tpu.remote
+    def plain():
+        try:
+            import uvdep  # noqa: F401
+
+            return "unexpectedly importable"
+        except ImportError:
+            import sys
+
+            return sys.executable
+
+    value, venv_py = ray_tpu.get(use_dep.remote(), timeout=180)
+    assert value == 7
+    base_py = ray_tpu.get(plain.remote(), timeout=120)
+    assert venv_py != base_py, "worker did not start inside the venv"
+    assert "uv-" in venv_py
+    # Cached venv reuse: second call is served by the same env.
+    value2, venv_py2 = ray_tpu.get(use_dep.remote(), timeout=60)
+    assert (value2, venv_py2) == (7, venv_py)
+
+
+@needs_uv
+def test_uv_env_build_failure_surfaces_fast(cluster_rt):
+    """An unbuildable uv env fails the task with RuntimeEnvSetupError
+    instead of respawning bootstrap workers forever."""
+    @ray_tpu.remote(runtime_env={"uv": ["--no-index",
+                                        "definitely-not-a-real-pkg"]})
+    def f():
+        return 1
+
+    from ray_tpu.core.errors import RuntimeEnvSetupError
+
+    with pytest.raises(RuntimeEnvSetupError) as ei:
+        ray_tpu.get(f.remote(), timeout=180)
+    assert "uv env build failed" in str(ei.value)
