@@ -112,7 +112,26 @@ class PopulationBasedTraining:
             if callable(spec):
                 out[key] = spec()
             elif isinstance(spec, (list, tuple)):
-                out[key] = self._rng.choice(list(spec))
+                # Perturb to an ADJACENT list entry (ref: pbt.py
+                # _explore — list-valued hyperparams step to a
+                # neighboring index, they are not re-drawn uniformly;
+                # a uniform draw can hand the exploited trial the very
+                # value it is being rescued from).
+                choices = list(spec)
+                try:
+                    idx = choices.index(out[key])
+                except ValueError:
+                    out[key] = self._rng.choice(choices)
+                    continue
+                if len(choices) == 1:
+                    continue
+                if idx == 0:
+                    idx = 1
+                elif idx == len(choices) - 1:
+                    idx = len(choices) - 2
+                else:
+                    idx = idx + self._rng.choice((-1, 1))
+                out[key] = choices[idx]
             else:  # continuous: the classic 0.8x / 1.2x perturbation
                 factor = self._rng.choice((0.8, 1.2))
                 out[key] = type(out[key])(out[key] * factor)

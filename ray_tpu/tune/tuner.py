@@ -105,6 +105,10 @@ class Trial:
     error: Optional[BaseException] = None
     checkpoint: Any = None     # latest tune.report(checkpoint=...) value
     num_restarts: int = 0      # PBT exploit restarts
+    # Exploit provenance: (source_trial_id, source_score) per exploit,
+    # so tests/analysis can verify adoption continuity (ref: pbt.py
+    # logging the exploit decision into trial metadata).
+    exploits: List[Any] = field(default_factory=list)
 
     def last_metrics(self) -> Dict:
         return self.history[-1] if self.history else {}
@@ -290,6 +294,9 @@ class Tuner:
                     t.config = exploit_decision["config"]
                     t.checkpoint = source.checkpoint
                     t.num_restarts += 1
+                    t.exploits.append(
+                        (source.trial_id,
+                         source.last_metrics().get(tc.metric)))
                     last_iter = max(
                         (r.get("training_iteration", 0)
                          for r in t.history), default=0)

@@ -24,6 +24,24 @@ DEFAULT_LEDGER = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), "PERF.jsonl")
 
+# Hard floors (ops/s, higher is better) — the round-5 VERDICT done-bars
+# for the control plane plus canaries for the scale benchmarks.  A
+# floored metric is judged ONLY against its floor: floors are the
+# contract, while best-ever comparisons on a shared noisy CI host
+# would punish one quiet run forever (the r4 ledger was recorded under
+# full-suite load at ~15 ops/s; an idle run is ~50x that).
+FLOORS: Dict[str, float] = {
+    "micro/tasks_sequential": 500.0,
+    "micro/tasks_batch": 3000.0,
+    "micro/actor_calls_sequential": 500.0,
+    "micro/actor_calls_batch": 3000.0,
+    "micro/put_get_small": 300.0,
+    "micro/put_get_4mb": 100.0,
+    "scale/many_tasks_inflight_10000": 1000.0,
+    "scale/queue_submit_100000": 3000.0,
+    "scale/many_actors_100": 2.0,
+}
+
 
 def record(entries: List[Dict[str, Any]], *, source: str,
            path: Optional[str] = None,
@@ -74,10 +92,27 @@ def check_regressions(path: Optional[str] = None, *,
             f'{r["source"]}/{r["benchmark"]}', []).append(r)
     problems: List[str] = []
     for name, recs in by_metric.items():
-        if len(recs) < 2:
-            continue
         recs.sort(key=lambda r: r["ts"])
         latest = recs[-1]
+        floor = FLOORS.get(name)
+        if floor is not None:
+            # Floors took effect with the r5 control-plane rework; the
+            # r4 rows predate them (recorded under full-suite load,
+            # before lease pooling existed) and are kept as history.
+            # Numeric round parse: "r10" must still be >= 5, and an
+            # untagged future record is held to the floor too.
+            tag = latest.get("round") or ""
+            try:
+                round_num = int(tag.lstrip("r") or "999")
+            except ValueError:
+                round_num = 999
+            if round_num >= 5 and latest["value"] < floor:
+                problems.append(
+                    f"{name}: {latest['value']:g} is below its floor "
+                    f"{floor:g} (VERDICT done-bar)")
+            continue
+        if len(recs) < 2:
+            continue
         earlier = recs[:-1]
         hib = latest.get("higher_is_better", True)
         if hib:

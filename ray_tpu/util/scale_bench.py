@@ -1,0 +1,135 @@
+"""Scale benchmarks — many tasks, many actors, deep queues.
+
+Role-equivalent to the reference's scalability envelope benchmarks
+(ref: release/benchmarks/README.md:9-31 — 10k+ simultaneous tasks,
+40k actors across a 2000-node cluster, 1M tasks queued on one 64-core
+node) scaled to a single-machine CI budget (<2 min total): the point
+is a regression canary on the control plane's many-task paths (lease
+pool + pipelined pushes), not a cluster-scale proof, which needs real
+fleet hardware the way the reference's release tests do.
+
+Run: ``python -m ray_tpu.util.scale_bench [--record] [--quick]``.
+
+Benchmarks:
+- many_tasks_inflight: submit N no-op tasks at once, wait for all —
+  end-to-end throughput with every task in flight simultaneously
+  (ref: benchmarks/single_node "10k+ simultaneous tasks" row).
+- queue_submit: raw owner-side submission rate with a deep backlog —
+  N tasks enter the scheduling-key queue far faster than workers
+  drain them (ref: "1M queued on one node": queueing must be cheap
+  and memory-bounded independent of drain rate).  Only a slice of the
+  queue is drained; the rest is cancelled in bulk (also a cancel-path
+  stress).
+- many_actors: create N cpu-free actors, round-trip one call on each,
+  kill them (ref: "40k actors" row; N is spawn-rate bound on one
+  host because every actor is a real OS process).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+
+def run(quick: bool = False) -> List[Dict[str, Any]]:
+    import ray_tpu
+
+    results: List[Dict[str, Any]] = []
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    def _timeit(name, fn, n):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        row = {"benchmark": name,
+               "value": round(n / dt, 1), "unit": "ops/s",
+               "total": n, "seconds": round(dt, 2)}
+        print(row, flush=True)
+        results.append(row)
+
+    # -- many tasks in flight -------------------------------------------
+    n_tasks = 1000 if quick else 10_000
+    ray_tpu.get([nop.remote() for _ in range(50)], timeout=120)  # warm
+
+    def many_tasks():
+        ray_tpu.get([nop.remote() for _ in range(n_tasks)],
+                    timeout=600)
+
+    _timeit(f"many_tasks_inflight_{n_tasks}", many_tasks, n_tasks)
+
+    # -- deep queue: submission rate + bulk cancel ----------------------
+    n_queue = 10_000 if quick else 100_000
+    drain = 1000
+
+    t0 = time.perf_counter()
+    refs = [nop.remote() for _ in range(n_queue)]
+    submit_dt = time.perf_counter() - t0
+    ray_tpu.get(refs[:drain], timeout=600)
+    for r in refs[drain:]:
+        ray_tpu.cancel(r)
+    row = {"benchmark": f"queue_submit_{n_queue}",
+           "value": round(n_queue / submit_dt, 1),
+           "unit": "ops/s", "total": n_queue,
+           "seconds": round(submit_dt, 2)}
+    print(row, flush=True)
+    results.append(row)
+    # Let cancellations settle so the actor phase starts clean.
+    time.sleep(1.0)
+
+    # -- many actors ----------------------------------------------------
+    n_actors = 20 if quick else 100
+
+    @ray_tpu.remote(num_cpus=0)
+    class Probe:
+        def ping(self):
+            return 1
+
+    def many_actors():
+        actors = [Probe.remote() for _ in range(n_actors)]
+        ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+        for a in actors:
+            ray_tpu.kill(a)
+
+    _timeit(f"many_actors_{n_actors}", many_actors, n_actors)
+    return results
+
+
+def main() -> None:
+    import argparse
+
+    import ray_tpu
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--record", action="store_true")
+    args = parser.parse_args()
+    # Actor creation = real process spawn; on a loaded CI host many
+    # concurrent interpreter starts can exceed the default readiness
+    # bound.  Must be set BEFORE init so the driver's config snapshot
+    # carries it.
+    import os as _os
+
+    _os.environ.setdefault("RT_ACTOR_READY_TIMEOUT_S", "600")
+    owns = not ray_tpu.is_initialized()
+    if owns:
+        ray_tpu.init(mode="cluster", num_cpus=4)
+    try:
+        results = run(quick=args.quick)
+    finally:
+        if owns:
+            ray_tpu.shutdown()
+    import json
+
+    for r in results:
+        print(json.dumps(r))
+    if args.record:
+        from . import perf_ledger
+
+        perf_ledger.record(results, source="scale")
+
+
+if __name__ == "__main__":
+    main()

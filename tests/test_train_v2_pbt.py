@@ -120,10 +120,17 @@ def test_pbt_population_converges(rt):
     best = grid.get_best_result()
     assert best.metrics["score"] > 10, best.metrics
     for t in restarted:
-        # Restart resumed from the source's checkpoint: history after
-        # restart continues climbing rather than restarting at ~rate.
+        # Restart resumed from the source's checkpoint: the final
+        # score must be at least the exploited source's score at
+        # adoption (continuity), not a from-scratch restart.  The
+        # mutated config may still be a poor lr, so "keeps climbing
+        # fast" is NOT guaranteed — adoption is.
+        assert t.exploits, t
+        src_score = max(s for _tid, s in t.exploits
+                        if s is not None)
         post = [r["score"] for r in t.history]
-        assert post[-1] > 5, (t.config, post)
+        assert post[-1] >= src_score - 1e-6, \
+            (t.config, src_score, post[-3:])
 
 
 def test_elastic_policy_sizes_by_tpu_not_cpu():
