@@ -158,7 +158,10 @@ class RemoteFunction:
             func_blob=blob,
             args=task_args,
             kwargs_keys=kw_keys,
-            num_returns=opts["num_returns"],
+            num_returns=(TaskSpec.STREAMING
+                         if opts["num_returns"] in ("streaming",
+                                                    "dynamic")
+                         else opts["num_returns"]),
             resources=task_resources(
                 opts["num_cpus"], opts["num_tpus"], opts["memory"],
                 opts["resources"]),
@@ -173,6 +176,8 @@ class RemoteFunction:
 
             tracing.inject(spec)
         refs = rt.submit_task(spec)
+        if spec.is_streaming:
+            return refs[0]  # an ObjectRefGenerator
         return refs[0] if spec.num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):
@@ -231,8 +236,10 @@ class ActorHandle:
                 f"Actor {self._class_name} has no method {name!r}")
         return ActorMethod(self, name)
 
-    def _submit_method(self, method: str, args, kwargs, num_returns: int):
+    def _submit_method(self, method: str, args, kwargs, num_returns):
         rt = _runtime_mod.get_runtime()
+        if num_returns in ("streaming", "dynamic"):
+            num_returns = TaskSpec.STREAMING
         task_args, kw_keys = _build_args(args, kwargs)
         spec = TaskSpec(
             task_id=rt.next_actor_task_id(self._actor_id),
@@ -253,6 +260,8 @@ class ActorHandle:
 
             tracing.inject(spec)
         refs = rt.submit_actor_task(spec)
+        if spec.is_streaming:
+            return refs[0]  # an ObjectRefGenerator
         return refs[0] if num_returns == 1 else refs
 
     def __repr__(self):

@@ -82,6 +82,32 @@ class _CancelledInFlight(Exception):
     """Internal: submission observed its cancel flag mid-flight."""
 
 
+class _StreamState:
+    """Owner-side state of one streaming-generator task (ref: the
+    owner half of ObjectRefGenerator, _raylet.pyx:284): item refs
+    arrive as stream_item notifies and queue here until the consumer
+    nexts them; `done` latches on the final TaskResult."""
+
+    __slots__ = ("ready", "produced", "consumed", "done", "error",
+                 "total", "event", "lock", "worker_addr",
+                 "error_delivered")
+
+    def __init__(self):
+        import collections
+        import threading
+
+        self.ready = collections.deque()   # ObjectIDs in yield order
+        self.produced = 0
+        self.consumed = 0
+        self.done = False
+        self.error: Optional[Any] = None
+        self.total: Optional[int] = None
+        self.event = threading.Event()
+        self.lock = threading.Lock()
+        self.worker_addr: Optional[str] = None
+        self.error_delivered = False
+
+
 class _PooledLease:
     """A granted worker lease cached by the owner for task reuse (ref:
     normal_task_submitter.h:74 — the submitter caches leased workers
@@ -198,6 +224,7 @@ class ClusterRuntime(BaseRuntime):
         # all state touched only on the io loop thread.
         self._sched_states: Dict[tuple, _SchedKeyState] = {}
         self._lease_sweeper: Optional[asyncio.Task] = None
+        self._streams: Dict[str, _StreamState] = {}
         self._shutdown_flag = False
         self._event_cursor = 0
         # Worker-role: current lease for blocked-CPU accounting.
@@ -487,14 +514,112 @@ class ClusterRuntime(BaseRuntime):
         except (RpcError, RemoteCallError, asyncio.CancelledError):
             pass
 
+    @property
+    def caller_tag(self) -> str:
+        """Tag this runtime registers on worker connections; workers
+        notify stream items back to it."""
+        return f"owner-{self._runtime_id}"
+
     async def _worker_client(self, addr: str) -> RpcClient:
         cli = self._worker_clients.get(addr)
         if cli is None or not cli.connected:
-            cli = RpcClient(addr, tag=f"owner-{self._runtime_id}",
+            cli = RpcClient(addr, tag=self.caller_tag,
                             connect_timeout=10.0)
+            cli.on_notify("stream_item", self._on_stream_item)
             await cli.connect()
             self._worker_clients[addr] = cli
         return cli
+
+    # ---------------------------------------------- streaming generators
+    def _on_stream_item(self, p: Dict) -> None:
+        """Io-loop: a generator task yielded item ``index`` (ref:
+        the owner-side report handling behind ObjectRefGenerator)."""
+        st = self._streams.get(p["task_id"].hex())
+        if st is None:
+            return
+        oid = p["object_id"]
+        kind, data = p["entry"]
+        with self._refs_lock:
+            self._owned_ids.add(oid)
+            if kind != "inline":
+                self._owned_plane.add(oid)
+        if kind == "inline":
+            from . import serialization
+
+            self.memory.put(oid, serialization.unpack(data))
+        else:
+            size, node_hint = data
+            self.memory.put(oid, _StoreRef(size, node_hint))
+        with st.lock:
+            st.ready.append(oid)
+            st.produced = max(st.produced, p["index"])
+        st.event.set()
+
+    def _finalize_stream(self, spec: TaskSpec,
+                         result: Optional[TaskResult],
+                         error: Optional[Any] = None) -> None:
+        st = self._streams.get(spec.task_id.hex())
+        sentinel = spec.return_object_ids()[0]
+        sub = self._submissions.pop(sentinel, None)
+        if sub is not None:
+            sub.done = True
+        if st is not None:
+            with st.lock:
+                st.done = True
+                if result is not None and result.ok:
+                    st.total = result.streamed
+                else:
+                    st.error = (result.error if result is not None
+                                else error)
+            st.event.set()
+        self._store_result_value(sentinel, None)
+        if result is not None:
+            for emb in result.transit_refs or []:
+                self._notify_async("remove_borrower", {
+                    "object_id": emb,
+                    "holder": f"transit:{spec.task_id.hex()}"})
+
+    def _stream_put_error(self, oid: ObjectID, err: Any) -> None:
+        with self._refs_lock:
+            self._owned_ids.add(oid)
+        self.memory.put(oid, err)
+
+    def _stream_close(self, task_id) -> None:
+        """Drop a stream's owner-side state (consumer exhausted or
+        abandoned it); a still-running producer gets a best-effort
+        cancel so its backpressure wait can't spin forever."""
+        st = self._streams.pop(task_id.hex(), None)
+        if st is None or st.done:
+            return
+        from .ids import ObjectID as _OID
+
+        sentinel = _OID.for_task_return(task_id, 0)
+        sub = self._submissions.get(sentinel)
+        if sub is not None and not sub.done:
+            sub.cancelled = True
+            self.io.call_soon(sub.cancel_event.set)
+            try:
+                self.io.run(self._cancel_inflight(sub), timeout=5.0)
+            except Exception:
+                pass
+
+    def stream_ack(self, task_id, consumed: int,
+                   worker_addr: Optional[str]) -> None:
+        """Generator consumer thread: release executor backpressure."""
+        if worker_addr is None:
+            return
+
+        async def _send():
+            try:
+                cli = await self._worker_client(worker_addr)
+                await cli.notify("stream_ack", {
+                    "task_id": task_id, "consumed": consumed})
+            except (RpcError, OSError):
+                pass  # worker gone; the final result surfaces it
+
+        from .rpc import spawn_task
+
+        self.io.call_soon(lambda: spawn_task(_send(), self.io.loop))
 
     async def _event_poll_loop(self):
         """Long-poll controller pubsub to invalidate actor caches and
@@ -582,6 +707,8 @@ class ClusterRuntime(BaseRuntime):
 
     # ------------------------------------------------------- normal tasks
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        if spec.is_streaming:
+            self._streams[spec.task_id.hex()] = _StreamState()
         oids = spec.return_object_ids()
         self._mark_pending(oids)
         held = [a.object_id for a in spec.args
@@ -598,6 +725,10 @@ class ClusterRuntime(BaseRuntime):
 
         self.io.call_soon(lambda: spawn_task(
             self._submit_normal(spec, sub, held), self.io.loop))
+        if spec.is_streaming:
+            from .object_ref import ObjectRefGenerator
+
+            return [ObjectRefGenerator(spec.task_id, oids[0])]
         return [ObjectRef(o) for o in oids]
 
     async def _submit_normal(self, spec: TaskSpec,
@@ -623,6 +754,12 @@ class ClusterRuntime(BaseRuntime):
             self._fail_returns(spec, e)
             return
         attempts_left = spec.max_retries
+        if spec.is_streaming:
+            # Streaming tasks never retry: items already delivered to
+            # the consumer cannot be un-consumed, so a replay would
+            # duplicate them (documented deviation: the reference
+            # replays generators and dedups by item index).
+            attempts_left = 0
         recoveries_left = 3  # bound on lost-arg reconstruct-and-retry
         delay = self.config.task_retry_delay_ms / 1000.0
         while True:
@@ -660,6 +797,9 @@ class ClusterRuntime(BaseRuntime):
                 return
             if not result.ok:
                 err = result.error
+                if spec.is_streaming:
+                    self._finalize_stream(spec, result)
+                    return
                 if isinstance(err, ObjectLostError) and not sub.cancelled \
                         and recoveries_left > 0 \
                         and await self._recover_lost_args(spec) \
@@ -860,11 +1000,16 @@ class ClusterRuntime(BaseRuntime):
             sub.worker_addr = pl.worker_addr
             sub.worker_id = pl.worker_id
             sub.pushed = True
+            if spec.is_streaming:
+                stream = self._streams.get(spec.task_id.hex())
+                if stream is not None:
+                    stream.worker_addr = pl.worker_addr
             try:
                 worker = await self._worker_client(pl.worker_addr)
                 reply = await worker.call("push_task", {
                     "spec": spec, "chip_ids": pl.chip_ids,
-                    "lease_id": pl.lease_id})
+                    "lease_id": pl.lease_id,
+                    "caller_tag": self.caller_tag})
             except Exception as e:  # noqa: BLE001 — relayed to waiter
                 # Worker or its node failed mid-push: this lease is
                 # unusable.  Tell the agent (best effort) so the CPU
@@ -1073,11 +1218,16 @@ class ClusterRuntime(BaseRuntime):
         sub.worker_addr = grant["worker_addr"]
         sub.worker_id = grant.get("worker_id")
         sub.pushed = True
+        if spec.is_streaming:
+            stream = self._streams.get(spec.task_id.hex())
+            if stream is not None:
+                stream.worker_addr = grant["worker_addr"]
         try:
             worker = await self._worker_client(grant["worker_addr"])
             reply = await worker.call("push_task", {
                 "spec": spec, "chip_ids": grant.get("chip_ids", []),
-                "lease_id": lease_id})
+                "lease_id": lease_id,
+                "caller_tag": self.caller_tag})
             return reply
         finally:
             try:
@@ -1128,6 +1278,9 @@ class ClusterRuntime(BaseRuntime):
         return None
 
     def _fail_returns(self, spec: TaskSpec, err: TaskError) -> None:
+        if spec.is_streaming:
+            self._finalize_stream(spec, None, error=err)
+            return
         for oid in spec.return_object_ids():
             sub = self._submissions.pop(oid, None)
             if sub is not None:
@@ -1135,6 +1288,9 @@ class ClusterRuntime(BaseRuntime):
             self._store_result_value(oid, err)
 
     def _accept_returns(self, spec: TaskSpec, result: TaskResult) -> None:
+        if spec.is_streaming:
+            self._finalize_stream(spec, result)
+            return
         from . import serialization
 
         oids = spec.return_object_ids()
@@ -1282,8 +1438,17 @@ class ClusterRuntime(BaseRuntime):
             raise  # terminal: user code / placement impossibility
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        if spec.is_streaming:
+            self._streams[spec.task_id.hex()] = _StreamState()
         oids = spec.return_object_ids()
         self._mark_pending(oids)
+        if spec.is_streaming:
+            # cancel(gen) must find a routable submission — actor
+            # tasks normally have none, but a runaway stream needs
+            # the worker-side cancel path.
+            sub = _Submission(spec)
+            for oid in oids:
+                self._submissions[oid] = sub
         held = [a.object_id for a in spec.args
                 if a.kind == ArgKind.OBJECT_REF and a.object_id is not None]
         self._add_submitted_holds(held)
@@ -1293,6 +1458,10 @@ class ClusterRuntime(BaseRuntime):
             self.promote_refs_to_plane(embedded)
         self.io.call_soon(lambda: self.io.loop.create_task(
             self._submit_actor(spec, held)))
+        if spec.is_streaming:
+            from .object_ref import ObjectRefGenerator
+
+            return [ObjectRefGenerator(spec.task_id, oids[0])]
         return [ObjectRef(o) for o in oids]
 
     async def _actor_info(self, actor_id: ActorID,
@@ -1372,9 +1541,19 @@ class ClusterRuntime(BaseRuntime):
                 self._fail_returns(spec, ActorError.from_exception(e))
                 return
             try:
+                if spec.is_streaming:
+                    stream = self._streams.get(spec.task_id.hex())
+                    if stream is not None:
+                        stream.worker_addr = info["worker_addr"]
+                    ssub = self._submissions.get(
+                        spec.return_object_ids()[0])
+                    if ssub is not None:
+                        ssub.worker_addr = info["worker_addr"]
+                        ssub.pushed = True
                 worker = await self._worker_client(info["worker_addr"])
                 fut = worker.call_nowait("push_actor_task", {
-                    "spec": spec, "caller_id": self._runtime_id})
+                    "spec": spec, "caller_id": self._runtime_id,
+                    "caller_tag": self.caller_tag})
             except RpcError:
                 fut = None  # dial failed: serial path refreshes state
             if fut is None:
@@ -1421,9 +1600,19 @@ class ClusterRuntime(BaseRuntime):
                 self._fail_returns(spec, ActorError.from_exception(e))
                 return
             try:
+                if spec.is_streaming:
+                    stream = self._streams.get(spec.task_id.hex())
+                    if stream is not None:
+                        stream.worker_addr = info["worker_addr"]
+                    ssub = self._submissions.get(
+                        spec.return_object_ids()[0])
+                    if ssub is not None:
+                        ssub.worker_addr = info["worker_addr"]
+                        ssub.pushed = True
                 worker = await self._worker_client(info["worker_addr"])
                 reply = await worker.call("push_actor_task", {
-                    "spec": spec, "caller_id": self._runtime_id})
+                    "spec": spec, "caller_id": self._runtime_id,
+                    "caller_tag": self.caller_tag})
             except (RpcError, RemoteCallError) as e:
                 # Worker gone: refresh state; retry while restarting if the
                 # method has a retry budget, else surface death.

@@ -41,6 +41,7 @@ class LocalRuntime(BaseRuntime):
     def __init__(self, config, job_id=None):
         super().__init__(config, job_id)
         self._store: Dict[ObjectID, Any] = {}
+        self._streams: Dict[str, Any] = {}
         self._actors: Dict[ActorID, _ActorSlot] = {}
         self._named: Dict[Tuple[str, str], Any] = {}
         self._func_cache: Dict[str, Any] = {}
@@ -109,6 +110,8 @@ class LocalRuntime(BaseRuntime):
 
     # -- Runtime interface --------------------------------------------------
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        if spec.is_streaming:
+            return self._submit_streaming(spec)
         try:
             fn = self._load_func(spec)
             pos, kwargs = self._resolve_args(spec)
@@ -116,6 +119,44 @@ class LocalRuntime(BaseRuntime):
             return self._store_returns(spec, result)
         except BaseException as e:  # noqa: BLE001 — stored, raised at get()
             return self._store_error(spec, TaskError.from_exception(e))
+
+    def _submit_streaming(self, spec: TaskSpec) -> List:
+        """Local-mode generator task: items evaluate eagerly into the
+        store; the returned ObjectRefGenerator drains a pre-completed
+        stream (cluster mode streams incrementally)."""
+        from .cluster_runtime import _StreamState
+        from .object_ref import ObjectRefGenerator
+
+        st = _StreamState()
+        idx = 0
+        try:
+            fn = self._load_func(spec)
+            pos, kwargs = self._resolve_args(spec)
+            gen = self._run_in_task_context(spec, fn, *pos, **kwargs)
+            for item in gen:
+                idx += 1
+                oid = ObjectID.for_task_return(spec.task_id, idx)
+                with self._lock:
+                    self._store[oid] = item
+                st.ready.append(oid)
+            st.total = idx
+        except BaseException as e:  # noqa: BLE001 — delivered as item
+            st.error = TaskError.from_exception(e)
+        st.produced = idx
+        st.done = True
+        self._streams[spec.task_id.hex()] = st
+        return [ObjectRefGenerator(spec.task_id,
+                                   spec.return_object_ids()[0])]
+
+    def stream_ack(self, task_id, consumed, worker_addr) -> None:
+        pass  # eager local streams have no executor to un-block
+
+    def _stream_close(self, task_id) -> None:
+        self._streams.pop(task_id.hex(), None)
+
+    def _stream_put_error(self, oid, err) -> None:
+        with self._lock:
+            self._store[oid] = err
 
     def create_actor(self, spec: TaskSpec) -> None:
         # Name conflicts must fail BEFORE running the user's __init__ —

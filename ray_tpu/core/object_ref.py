@@ -118,3 +118,135 @@ class ActorHandleRef:
 
     def __init__(self, state):
         self.state = state
+
+
+class ObjectRefGenerator:
+    """Iterator over the ObjectRefs a streaming task yields (ref:
+    python/ray/_raylet.pyx:284 ObjectRefGenerator /
+    num_returns="streaming").  ``next()`` blocks until the executor
+    reports the next item (or the task completes), returns its
+    ObjectRef, and acks consumption so the executor's backpressure
+    window advances.  A mid-generator exception is delivered as one
+    final ref whose ``get`` raises, then StopIteration — matching the
+    reference's error-object semantics.  Async iteration offloads the
+    blocking wait to the default executor.
+    """
+
+    def __init__(self, task_id, sentinel_id: ObjectID):
+        self.task_id = task_id
+        # Submission bookkeeping (cancel, pending) anchors on the
+        # sentinel id; expose it as .id so ray_tpu.cancel(gen) works.
+        self.id = sentinel_id
+        self._closed = False
+
+    # ------------------------------------------------------ sync iterator
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        return self._next_ref(timeout=None)
+
+    def _next_ref(self, timeout) -> "ObjectRef":
+        import time as _time
+
+        from . import runtime as _runtime
+        from .errors import GetTimeoutError
+
+        rt = _runtime.get_runtime()
+        st = rt._streams.get(self.task_id.hex())
+        if st is None:
+            raise StopIteration
+        deadline = (_time.monotonic() + timeout
+                    if timeout is not None else None)
+        while True:
+            with st.lock:
+                if st.ready:
+                    oid = st.ready.popleft()
+                    st.consumed += 1
+                    consumed = st.consumed
+                    worker = st.worker_addr
+                    ref = ObjectRef(oid)
+                    rt.stream_ack(self.task_id, consumed, worker)
+                    return ref
+                if st.done:
+                    if st.error is None and st.total is not None \
+                            and st.consumed < st.total:
+                        # The producer reported N items but fewer
+                        # arrived (a dropped connection can lose
+                        # in-flight notifies): surface loss, never a
+                        # silently short stream.
+                        from .errors import ObjectLostError
+
+                        st.error = ObjectLostError(
+                            f"stream lost items "
+                            f"{st.consumed + 1}..{st.total} of "
+                            f"{self.task_id.hex()[:16]} in transit")
+                    if st.error is not None and not st.error_delivered:
+                        # Deliver the failure as one final item ref.
+                        st.error_delivered = True
+                        from .ids import ObjectID as _OID
+
+                        oid = _OID.for_task_return(self.task_id,
+                                                   st.produced + 1)
+                        rt._stream_put_error(oid, st.error)
+                        return ObjectRef(oid)
+                    rt._streams.pop(self.task_id.hex(), None)
+                    raise StopIteration
+                st.event.clear()
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise GetTimeoutError(
+                        f"no stream item within {timeout}s")
+            st.event.wait(remaining if remaining is not None else 1.0)
+
+    # ----------------------------------------------------- async iterator
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> "ObjectRef":
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        done = object()
+
+        def _safe_next():
+            # StopIteration must not cross the executor boundary:
+            # asyncio.Future.set_exception rejects it (PEP 479
+            # interaction), which would kill the awaiting coroutine
+            # with a TypeError instead of ending the iteration.
+            try:
+                return self.__next__()
+            except StopIteration:
+                return done
+
+        item = await loop.run_in_executor(None, _safe_next)
+        if item is done:
+            raise StopAsyncIteration
+        return item
+
+    def close(self) -> None:
+        """Release owner-side stream state; cancels a still-running
+        producer (an abandoned unbounded stream must not spin in its
+        backpressure wait forever)."""
+        if self._closed:
+            return
+        self._closed = True
+        from . import runtime as _runtime
+
+        rt = _runtime.get_runtime_quiet()
+        if rt is not None:
+            try:
+                rt._stream_close(self.task_id)
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter teardown
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self.task_id.hex()[:12]})"

@@ -121,6 +121,22 @@ class RpcServer:
     def register(self, name: str, fn: Callable) -> None:
         self._handlers[name] = fn
 
+    def notify_peer(self, tag: str, method: str, payload: Any) -> bool:
+        """Push a NOTIFY frame to a connected peer by its registered
+        tag (server -> client direction — the channel streaming task
+        results and generator items ride on; the reference's
+        equivalent is the worker->owner report RPC stream in
+        core_worker.proto).  Returns False when the peer is gone."""
+        writer = self._conns.get(tag)
+        if writer is None:
+            return False
+        try:
+            writer.write(_encode_frame((_NOTIFY, 0, method, payload)))
+            return True
+        except (ConnectionError, OSError, RuntimeError):
+            self._conns.pop(tag, None)
+            return False
+
     def on_connection_lost(self, cb: Callable[[str], None]) -> None:
         """cb(peer_tag) fires when a registered peer's connection drops."""
         self._conn_lost_cb = cb
@@ -249,6 +265,13 @@ class RpcClient:
         self._read_task: Optional[asyncio.Task] = None
         self._lock = asyncio.Lock()
         self._closed = False
+        # Client-side NOTIFY dispatch: the server may push frames at
+        # us (stream items, batched results); handlers are plain
+        # callables run inline on the read loop — keep them fast.
+        self._notify_handlers: Dict[str, Callable[[Any], None]] = {}
+
+    def on_notify(self, method: str, fn: Callable[[Any], None]) -> None:
+        self._notify_handlers[method] = fn
 
     async def connect(self) -> None:
         async with self._lock:
@@ -283,6 +306,16 @@ class RpcClient:
                              req_id, self.address, id(self),
                              "" if req_id in self._pending
                              else " (UNMATCHED)")
+                if kind == _NOTIFY:
+                    fn = self._notify_handlers.get(_method)
+                    if fn is not None:
+                        try:
+                            fn(payload)
+                        except Exception:
+                            logger.exception(
+                                "client notify handler %s failed",
+                                _method)
+                    continue
                 fut = self._pending.pop(req_id, None)
                 if fut is None or fut.done():
                     continue

@@ -84,7 +84,21 @@ class TaskSpec:
     # span injection through submission); None when tracing is off.
     trace_ctx: Optional[Dict[str, str]] = None
 
+    # num_returns sentinel for streaming generators (ref:
+    # num_returns="streaming" / ObjectRefGenerator, _raylet.pyx:284):
+    # the executor reports yielded items incrementally; return ids are
+    # minted per yield as for_task_return(task_id, index).
+    STREAMING: int = -1
+
+    @property
+    def is_streaming(self) -> bool:
+        return self.num_returns == TaskSpec.STREAMING
+
     def return_object_ids(self) -> List[ObjectID]:
+        if self.is_streaming:
+            # The index-0 sentinel anchors submission bookkeeping
+            # (pending set, cancel routing); item ids start at 1.
+            return [ObjectID.for_task_return(self.task_id, 0)]
         return [
             ObjectID.for_task_return(self.task_id, i + 1)
             for i in range(self.num_returns)
@@ -116,3 +130,6 @@ class TaskResult:
     # a transit borrow on each until the owner confirms receipt (ownership
     # handoff, ref: reference_count.h borrowed-refs protocol).
     transit_refs: List[ObjectID] = field(default_factory=list)
+    # Streaming tasks: how many items were yielded before completion
+    # (items themselves travel as stream_item notifies).
+    streamed: int = 0

@@ -76,12 +76,18 @@ class Worker:
         # task_event_buffer.h:222 periodic flush to GcsTaskManager).
         self._event_buf: List[Dict] = []
         self._event_lock = threading.Lock()
+        # Streaming-generator state: per-task caller tag (notify
+        # target) and ack counters for executor backpressure.
+        self._stream_callers: Dict[str, str] = {}
+        self._stream_acks: Dict[str, Dict[str, Any]] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         for name in ["push_task", "create_actor", "push_actor_task",
                      "cancel_task", "ping", "exit", "dump_stack",
-                     "profile"]:
+                     "profile", "stream_ack"]:
             self.server.register(name, getattr(self, name))
 
     async def start(self) -> None:
+        self._loop = asyncio.get_event_loop()
         await self.server.start()
         self.runtime = ClusterRuntime(
             self.config,
@@ -223,7 +229,59 @@ class Worker:
             return pos, dict(zip(spec.kwargs_keys, kw_vals))
         return vals, {}
 
+    def _package_one(self, spec: TaskSpec, oid, value: Any,
+                     transit: list) -> Tuple[str, Any]:
+        """Package one return value: ("inline", bytes) or
+        ("store", (size, node_hint)); store-path objects are sealed +
+        registered, embedded refs get transit/induced borrows."""
+        from .object_ref import collect_embedded_refs
+
+        with collect_embedded_refs() as embedded:
+            payload, views = serialization.serialize(value)
+        if embedded:
+            # Any of our own in-band values whose refs ride in this
+            # return must become pullable by the receiver (in-band ->
+            # plane promotion; see cluster_runtime.py).
+            self.runtime.promote_refs_to_plane(list(embedded))
+        size = serialization.packed_size(payload, views)
+        if size <= self.config.object_inline_max_bytes:
+            buf = bytearray(size)
+            pos = 0
+            buf[pos:pos + 4] = len(views).to_bytes(4, "little"); pos += 4
+            buf[pos:pos + 8] = len(payload).to_bytes(8, "little"); pos += 8
+            buf[pos:pos + len(payload)] = payload; pos += len(payload)
+            for v in views:
+                n = len(v)
+                buf[pos:pos + 8] = n.to_bytes(8, "little"); pos += 8
+                buf[pos:pos + n] = v; pos += n
+            if embedded:
+                # Ownership handoff: hold a transit borrow on each ref
+                # embedded in the payload until the owner confirms
+                # receipt (released in _accept_returns) — otherwise
+                # this frame's refs die and free the objects before
+                # the owner ever sees them.
+                holder = f"transit:{spec.task_id.hex()}"
+                for emb in embedded:
+                    self.runtime.controller_call(
+                        "add_borrower",
+                        {"object_id": emb, "holder": holder})
+                transit.extend(embedded)
+            return ("inline", bytes(buf))
+        self.runtime.store.seal_parts(oid, payload, views)
+        self.runtime.agent_call(
+            "register_object", {"object_id": oid, "size": size})
+        if embedded:
+            # Embedded refs live as long as the container payload:
+            # the controller releases these borrows when the
+            # container object itself is freed.
+            self.runtime.controller_call(
+                "link_induced_borrows",
+                {"container": oid, "embedded": list(embedded)})
+        return ("store", (size, self.node_id_hex))
+
     def _package_returns(self, spec: TaskSpec, result: Any) -> TaskResult:
+        if spec.is_streaming:
+            return self._stream_returns(spec, result)
         if spec.num_returns == 1:
             values = [result]
         else:
@@ -233,57 +291,75 @@ class Worker:
                     f"Task {spec.display_name()} declared "
                     f"num_returns={spec.num_returns}, returned "
                     f"{len(values)}")
-        from .object_ref import collect_embedded_refs
-
         entries = []
         transit: list = []
         oids = spec.return_object_ids()
         for oid, value in zip(oids, values):
-            with collect_embedded_refs() as embedded:
-                payload, views = serialization.serialize(value)
-            if embedded:
-                # Any of our own in-band values whose refs ride in this
-                # return must become pullable by the receiver (in-band ->
-                # plane promotion; see cluster_runtime.py).
-                self.runtime.promote_refs_to_plane(list(embedded))
-            size = serialization.packed_size(payload, views)
-            if size <= self.config.object_inline_max_bytes:
-                buf = bytearray(size)
-                pos = 0
-                buf[pos:pos + 4] = len(views).to_bytes(4, "little"); pos += 4
-                buf[pos:pos + 8] = len(payload).to_bytes(8, "little"); pos += 8
-                buf[pos:pos + len(payload)] = payload; pos += len(payload)
-                for v in views:
-                    n = len(v)
-                    buf[pos:pos + 8] = n.to_bytes(8, "little"); pos += 8
-                    buf[pos:pos + n] = v; pos += n
-                entries.append(("inline", bytes(buf)))
-                if embedded:
-                    # Ownership handoff: hold a transit borrow on each ref
-                    # embedded in the payload until the owner confirms
-                    # receipt (released in _accept_returns) — otherwise
-                    # this frame's refs die and free the objects before
-                    # the owner ever sees them.
-                    holder = f"transit:{spec.task_id.hex()}"
-                    for emb in embedded:
-                        self.runtime.controller_call(
-                            "add_borrower",
-                            {"object_id": emb, "holder": holder})
-                    transit.extend(embedded)
-            else:
-                self.runtime.store.seal_parts(oid, payload, views)
-                self.runtime.agent_call(
-                    "register_object", {"object_id": oid, "size": size})
-                if embedded:
-                    # Embedded refs live as long as the container payload:
-                    # the controller releases these borrows when the
-                    # container object itself is freed.
-                    self.runtime.controller_call(
-                        "link_induced_borrows",
-                        {"container": oid, "embedded": list(embedded)})
-                entries.append(("store", (size, self.node_id_hex)))
+            entries.append(self._package_one(spec, oid, value, transit))
         return TaskResult(task_id=spec.task_id, ok=True, returns=entries,
                           transit_refs=transit)
+
+    # ------------------------------------------------- streaming returns
+    def _stream_returns(self, spec: TaskSpec, result: Any) -> TaskResult:
+        """Drive a generator task: each yielded value is packaged and
+        pushed to the owner as a stream_item notify, with executor-side
+        backpressure on unconsumed items (ref: _raylet.pyx:284
+        ObjectRefGenerator + generator_waiter.h — the executor pauses
+        when the owner lags).  Runs ON the executor thread; notify
+        writes marshal to the worker's event loop."""
+        import threading
+
+        from .ids import ObjectID
+
+        if not inspect.isgenerator(result) and \
+                not hasattr(result, "__next__"):
+            raise TypeError(
+                f"num_returns='streaming' task "
+                f"{spec.display_name()} returned "
+                f"{type(result).__name__}, not a generator")
+        tid = spec.task_id
+        caller = self._stream_callers.get(tid.hex())
+        state = self._stream_acks.setdefault(
+            tid.hex(), {"consumed": 0, "event": threading.Event()})
+        max_pending = 16
+        loop = self._loop
+        idx = 0
+        transit: list = []
+        try:
+            for item in result:
+                idx += 1
+                oid = ObjectID.for_task_return(tid, idx)
+                entry = self._package_one(spec, oid, item, transit)
+                payload = {"task_id": tid, "index": idx,
+                           "object_id": oid, "entry": entry}
+                if caller is not None:
+                    loop.call_soon_threadsafe(
+                        self.server.notify_peer, caller,
+                        "stream_item", payload)
+                # Backpressure: wait for the owner to consume within
+                # max_pending of what we've produced.  A cancelled
+                # task unblocks via the async-raise in cancel_task.
+                while idx - state["consumed"] > max_pending:
+                    state["event"].clear()
+                    state["event"].wait(timeout=1.0)
+            return TaskResult(task_id=tid, ok=True, returns=[],
+                              transit_refs=transit, streamed=idx)
+        except BaseException:
+            # The failure TaskResult carries no transit list, so the
+            # owner can't release the borrows of already-streamed
+            # items — release them here or they pin objects forever.
+            holder = f"transit:{tid.hex()}"
+            for emb in transit:
+                try:
+                    self.runtime.controller_call(
+                        "remove_borrower",
+                        {"object_id": emb, "holder": holder})
+                except Exception:
+                    pass
+            raise
+        finally:
+            self._stream_acks.pop(tid.hex(), None)
+            self._stream_callers.pop(tid.hex(), None)
 
     def _execute_sync(self, spec: TaskSpec, fn, lease_id: Optional[int],
                       chip_ids: List[int]) -> TaskResult:
@@ -337,6 +413,12 @@ class Worker:
                               error=kind.from_exception(e))
         finally:
             self._current_sync_task = None
+            if spec.is_streaming:
+                # A streaming task that failed before its generator
+                # drive started (bad args, cancel-before-start, user
+                # fn raised) must not leak its caller/ack entries.
+                self._stream_callers.pop(spec.task_id.hex(), None)
+                self._stream_acks.pop(spec.task_id.hex(), None)
             if span is not None:
                 from ..util import tracing as _tracing
 
@@ -360,10 +442,22 @@ class Worker:
                 error=TaskError.from_exception(
                     RuntimeEnvSetupError(env_err)))
         fn = self._load_func(spec)
+        if spec.is_streaming:
+            self._stream_callers[spec.task_id.hex()] = \
+                p.get("caller_tag", "")
         loop = asyncio.get_event_loop()
         return await loop.run_in_executor(
             self._task_executor, self._execute_sync, spec, fn,
             p.get("lease_id"), p.get("chip_ids") or [])
+
+    async def stream_ack(self, p):
+        """Owner consumed stream items up to ``consumed`` — release
+        executor backpressure (ref: generator_waiter.h signal)."""
+        st = self._stream_acks.get(p["task_id"].hex())
+        if st is not None:
+            st["consumed"] = max(st["consumed"], int(p["consumed"]))
+            st["event"].set()
+        return {"ok": True}
 
     # -------------------------------------------------------------- actors
     async def create_actor(self, p):
@@ -452,6 +546,9 @@ class Worker:
                 error=ActorError.from_exception(AttributeError(
                     f"actor has no method {spec.method_name!r}")))
         del caller
+        if spec.is_streaming:
+            self._stream_callers[spec.task_id.hex()] = \
+                p.get("caller_tag", "")
         lock = getattr(self, "_actor_exec_lock", None)
         if lock is not None:
             async with lock:

@@ -497,17 +497,68 @@ class Dataset:
 
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: str = "numpy",
-                     drop_last: bool = False) -> Iterator[Any]:
-        import numpy as np
-
+                     drop_last: bool = False,
+                     prefetch_blocks: int = 0) -> Iterator[Any]:
+        """Row batches; ``prefetch_blocks`` > 0 pulls that many blocks
+        ahead on a background thread so a training step's host time
+        overlaps the next blocks' task execution + object-plane pulls
+        (ref: iterator.py prefetch_batches in the reference — the
+        consumer-side half of streaming execution)."""
+        blocks = (self._iter_blocks() if prefetch_blocks <= 0
+                  else self._iter_blocks_prefetched(prefetch_blocks))
         buf: List[Any] = []
-        for block in self._iter_blocks():
+        for block in blocks:
             buf.extend(BlockAccessor.for_block(block).iter_rows())
             while len(buf) >= batch_size:
                 chunk, buf = buf[:batch_size], buf[batch_size:]
                 yield self._format_batch(chunk, batch_format)
         if buf and not drop_last:
             yield self._format_batch(buf, batch_format)
+
+    def _iter_blocks_prefetched(self, depth: int) -> Iterator[Block]:
+        """Background-thread block prefetcher with a bounded queue —
+        the queue depth is the backpressure window."""
+        import queue as _queue
+        import threading as _threading
+
+        q: "_queue.Queue" = _queue.Queue(maxsize=max(depth, 1))
+        _END = object()
+        stop = _threading.Event()
+
+        def _put(item) -> bool:
+            # Bounded put that aborts on stop: a consumer that drops
+            # the iterator mid-stream must not leave this thread
+            # blocked on a full queue forever.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.25)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def _feed():
+            try:
+                for b in self._iter_blocks():
+                    if not _put(b):
+                        return
+                _put(_END)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                _put(e)
+
+        t = _threading.Thread(target=_feed, daemon=True,
+                              name="rt-data-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
 
     @staticmethod
     def _format_batch(rows: List[Any], batch_format: str):

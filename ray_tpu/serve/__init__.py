@@ -67,9 +67,17 @@ def run(app: Application, *, name: str = "default",
                 "upscale_delay_s": ac.upscale_delay_s,
                 "downscale_delay_s": ac.downscale_delay_s,
             }
+        import inspect as _inspect
+
+        target_fn = (d.func_or_class if d.is_function
+                     else getattr(d.func_or_class, "__call__", None))
+        streaming = bool(target_fn is not None and (
+            _inspect.isgeneratorfunction(target_fn)
+            or _inspect.isasyncgenfunction(target_fn)))
         ray_tpu.get(ctl.deploy.remote(
             d.name, payload, args, kwargs, d.num_replicas,
-            d.is_function, prefix, d.ray_actor_options, autoscaling))
+            d.is_function, prefix, d.ray_actor_options, autoscaling,
+            streaming))
         return DeploymentHandle(d.name)
 
     handle = deploy_app(app, True)

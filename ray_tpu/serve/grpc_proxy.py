@@ -67,23 +67,17 @@ class GRPCProxy:
             except KeyError as e:
                 context.abort(_grpc.StatusCode.NOT_FOUND, str(e))
             except Exception as e:  # noqa: BLE001 — surface to client
+                from .controller import StreamingResponseRequired
+
+                cause = getattr(e, "cause", None) or \
+                    getattr(e, "__cause__", None) or e
+                if isinstance(cause, StreamingResponseRequired) or \
+                    "StreamingResponseRequired" in repr(e):
+                    context.abort(
+                        _grpc.StatusCode.INVALID_ARGUMENT,
+                        "deployment streams; use "
+                        "/ray_tpu.serve.Ingress/CallStream")
                 context.abort(_grpc.StatusCode.INTERNAL, repr(e))
-            if isinstance(result, dict) and "__rt_stream__" in result:
-                # Generator deployment called unary: free the
-                # replica-side stream and tell the client to use
-                # CallStream instead of leaking plumbing (abort raises,
-                # so it must run OUTSIDE the try above).
-                rep = handle.replica_by_key(result.get("replica", ""))
-                if rep is not None:
-                    try:
-                        rep.cancel_stream.remote(
-                            result["__rt_stream__"])
-                    except Exception:
-                        pass
-                context.abort(
-                    _grpc.StatusCode.INVALID_ARGUMENT,
-                    "deployment streams; use "
-                    "/ray_tpu.serve.Ingress/CallStream")
             return json.dumps({"result": result}).encode()
 
         def call_stream(request: bytes, context):

@@ -13,6 +13,7 @@ from typing import Dict, Optional
 class RouteTable:
     def __init__(self):
         self._cache: Dict[str, str] = {}
+        self._streaming: Dict[str, bool] = {}
         self._version = -1
         self._poller: Optional[threading.Thread] = None
         # The gRPC proxy calls get() from a thread POOL: without this
@@ -29,6 +30,11 @@ class RouteTable:
                         not self._poller.is_alive():
                     self._start()
         return self._cache
+
+    def is_streaming(self, name: str) -> bool:
+        """Whether a deployment's handler is a generator (the ingress
+        must take the streaming call path for it)."""
+        return bool(self._streaming.get(name))
 
     def resolve(self, path: str) -> Optional[str]:
         """Longest-prefix route match -> deployment name (or None)."""
@@ -49,6 +55,7 @@ class RouteTable:
             r = ray_tpu.get(ctl.poll_update.remote(None, -1, 0.0),
                             timeout=30)
             self._cache = r["routes"]
+            self._streaming = r.get("streaming", {})
             self._version = r["version"]
         except Exception:
             pass
@@ -63,6 +70,7 @@ class RouteTable:
                     r = ray_tpu.get(ctl.poll_update.remote(
                         None, self._version, 25.0), timeout=40)
                     self._cache = r["routes"]
+                    self._streaming = r.get("streaming", {})
                     self._version = r["version"]
                 except Exception:
                     _t.sleep(1.0)

@@ -120,3 +120,21 @@ def test_limit_after_union_and_zip(rt):
     assert a.union(b).limit(8).count() == 8
     assert a.zip(b).limit(4).take_all() == [
         (0, 100), (1, 101), (2, 102), (3, 103)]
+
+
+def test_iter_batches_prefetch(rt):
+    """prefetch_blocks pulls ahead on a background thread; results
+    are identical to the unprefetched path."""
+    ds = rtd.from_items(list(range(100)), parallelism=10).map(
+        lambda x: x * 3)
+    plain = [list(b) for b in ds.iter_batches(batch_size=16,
+                                              batch_format="list")]
+    pre = [list(b) for b in ds.iter_batches(batch_size=16,
+                                            batch_format="list",
+                                            prefetch_blocks=4)]
+    assert pre == plain
+    # Early abandonment must not wedge the feeder thread.
+    it = ds.iter_batches(batch_size=8, batch_format="list",
+                         prefetch_blocks=2)
+    assert len(next(it)) == 8
+    it.close()

@@ -1,0 +1,172 @@
+"""Streaming generators: num_returns="streaming" + ObjectRefGenerator
+(VERDICT r4 #3; ref: python/ray/_raylet.pyx:284 ObjectRefGenerator,
+src/ray/core_worker/generator_waiter.h backpressure)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    handle = ray_tpu.init(mode="cluster", num_cpus=2)
+    yield handle
+    ray_tpu.shutdown()
+
+
+def test_streaming_basic(rt):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    out = [ray_tpu.get(ref) for ref in gen.remote(5)]
+    assert out == [0, 10, 20, 30, 40]
+
+
+def test_streaming_incremental_delivery(rt):
+    """Items arrive BEFORE the generator finishes — the consumer gets
+    item 0 while the producer still sleeps on later items (the whole
+    point vs num_returns=N)."""
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        for i in range(4):
+            yield i
+            time.sleep(0.8)
+
+    g = slow_gen.remote()
+    t0 = time.monotonic()
+    first = ray_tpu.get(next(iter(g)), timeout=30)
+    first_latency = time.monotonic() - t0
+    assert first == 0
+    # 4 items x 0.8s sleep = >3.2s total; the first must beat that.
+    assert first_latency < 2.5, first_latency
+    rest = [ray_tpu.get(r, timeout=60) for r in g]
+    assert rest == [1, 2, 3]
+
+
+def test_streaming_large_items_through_store(rt):
+    """Items above the inline cap travel through the object plane."""
+    @ray_tpu.remote(num_returns="streaming")
+    def big_gen():
+        for i in range(3):
+            yield np.full(200_000, float(i))  # ~1.6MB > inline cap
+
+    vals = [ray_tpu.get(r, timeout=60) for r in big_gen.remote()]
+    assert [float(v[0]) for v in vals] == [0.0, 1.0, 2.0]
+    assert all(v.shape == (200_000,) for v in vals)
+
+
+def test_streaming_mid_generator_failure(rt):
+    """An exception mid-stream is delivered as the NEXT item (a ref
+    whose get raises), then the stream ends."""
+    @ray_tpu.remote(num_returns="streaming")
+    def bad_gen():
+        yield 1
+        yield 2
+        raise ValueError("boom mid-stream")
+
+    refs = list(bad_gen.remote())
+    assert len(refs) == 3
+    assert ray_tpu.get(refs[0]) == 1
+    assert ray_tpu.get(refs[1]) == 2
+    with pytest.raises(ValueError, match="boom mid-stream"):
+        ray_tpu.get(refs[2])
+
+
+def test_streaming_backpressure(rt):
+    """The executor pauses when the consumer lags: a 100-item stream
+    must not have produced all items while the consumer has read none
+    (window is 16)."""
+    @ray_tpu.remote(num_returns="streaming")
+    def counted_gen():
+        import os
+        import tempfile
+
+        marker = os.path.join(tempfile.gettempdir(),
+                              "rt_stream_count.txt")
+        for i in range(100):
+            with open(marker, "w") as f:
+                f.write(str(i))
+            yield i
+
+    g = counted_gen.remote()
+    time.sleep(3.0)  # give the producer time to run ahead if unbounded
+    import os
+    import tempfile
+
+    marker = os.path.join(tempfile.gettempdir(), "rt_stream_count.txt")
+    with open(marker) as f:
+        produced_before_consume = int(f.read())
+    assert produced_before_consume < 40, \
+        f"producer ran {produced_before_consume} items ahead unbounded"
+    assert [ray_tpu.get(r, timeout=60) for r in g] == list(range(100))
+
+
+def test_streaming_cancel(rt):
+    @ray_tpu.remote(num_returns="streaming")
+    def endless():
+        i = 0
+        while True:
+            yield i
+            i += 1
+            time.sleep(0.05)
+
+    g = endless.remote()
+    it = iter(g)
+    assert ray_tpu.get(next(it), timeout=30) == 0
+    ray_tpu.cancel(g)
+    # The stream must terminate (cancellation error as final item or
+    # plain StopIteration) rather than iterate forever.
+    seen_err = None
+    deadline = time.time() + 60
+    for ref in it:
+        assert time.time() < deadline, "stream never terminated"
+        try:
+            ray_tpu.get(ref, timeout=30)
+        except Exception as e:  # noqa: BLE001
+            seen_err = e
+            break
+    assert seen_err is None or "ancel" in repr(seen_err)
+
+
+def test_streaming_local_mode():
+    ray_tpu.shutdown()
+    ray_tpu.init(mode="local")
+    try:
+        @ray_tpu.remote(num_returns="streaming")
+        def gen():
+            yield "a"
+            yield "b"
+            raise RuntimeError("tail error")
+
+        refs = list(gen.remote())
+        assert ray_tpu.get(refs[0]) == "a"
+        assert ray_tpu.get(refs[1]) == "b"
+        with pytest.raises(RuntimeError, match="tail error"):
+            ray_tpu.get(refs[2])
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_streaming_actor_method():
+    """Actor methods stream too (the substrate Serve responses ride;
+    ref: ObjectRefGenerator from actor tasks)."""
+    ray_tpu.shutdown()
+    ray_tpu.init(mode="cluster", num_cpus=2)
+    try:
+        @ray_tpu.remote(max_concurrency=4)
+        class Chunker:
+            def chunks(self, n):
+                for i in range(n):
+                    yield {"chunk": i}
+
+        c = Chunker.remote()
+        gen = c.chunks.options(num_returns="streaming").remote(4)
+        items = [ray_tpu.get(r, timeout=60) for r in gen]
+        assert items == [{"chunk": i} for i in range(4)]
+    finally:
+        ray_tpu.shutdown()
