@@ -16,7 +16,7 @@ from typing import Any, Dict, Optional
 
 from .core import runtime as _runtime_mod
 from .core.api import (cancel, get, get_actor, get_runtime_context,  # noqa: F401
-                       kill, put, remote, wait)
+                       kill, method, put, remote, wait)
 from .core.api import ActorClass, ActorHandle, RemoteFunction  # noqa: F401
 from .core.config import RuntimeConfig
 from .core.errors import *  # noqa: F401,F403

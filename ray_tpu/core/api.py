@@ -34,6 +34,7 @@ _DEFAULT_OPTIONS = dict(
     max_restarts=0,
     max_task_retries=0,
     max_concurrency=1,
+    concurrency_groups=None,
     lifetime=None,
     namespace="",
     scheduling_strategy=None,
@@ -118,6 +119,23 @@ def _strategy(opts: Dict[str, Any]) -> SchedulingStrategy:
     raise ValueError(f"Unknown scheduling strategy {s!r}")
 
 
+def method(*, concurrency_group: str = "",
+           num_returns: Optional[Any] = None):
+    """``@ray_tpu.method(concurrency_group=...)`` — per-method actor
+    options (ref: ray.method + concurrency_group_manager.h:34: methods
+    bind to a named concurrency group; calls may override per-call via
+    ``.options(concurrency_group=...)``)."""
+
+    def wrap(fn):
+        fn.__rt_method_options__ = {
+            "concurrency_group": concurrency_group,
+            "num_returns": num_returns,
+        }
+        return fn
+
+    return wrap
+
+
 class RemoteFunction:
     """A function decorated with ``@remote``; call via ``.remote(...)``."""
 
@@ -188,22 +206,28 @@ class RemoteFunction:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str,
+                 num_returns: int = 1, concurrency_group: str = ""):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def options(self, **updates) -> "ActorMethod":
-        m = ActorMethod(self._handle, self._name, self._num_returns)
+        m = ActorMethod(self._handle, self._name, self._num_returns,
+                        self._concurrency_group)
         if "num_returns" in updates:
             m._num_returns = updates.pop("num_returns")
+        if "concurrency_group" in updates:
+            m._concurrency_group = updates.pop("concurrency_group")
         if updates:
             raise TypeError(f"Unsupported actor-method options: {list(updates)}")
         return m
 
     def remote(self, *args, **kwargs):
-        return self._handle._submit_method(self._name, args, kwargs,
-                                           self._num_returns)
+        return self._handle._submit_method(
+            self._name, args, kwargs, self._num_returns,
+            concurrency_group=self._concurrency_group)
 
     def bind(self, *args):
         """Build a DAG node from this method (ref: dag_node bind)."""
@@ -217,12 +241,17 @@ class ActorHandle:
 
     def __init__(self, actor_id: ActorID, class_name: str,
                  method_names: List[str], namespace: str = "",
-                 max_concurrency: int = 1):
+                 max_concurrency: int = 1, has_groups: bool = False,
+                 method_options: Optional[Dict[str, Dict]] = None,
+                 group_names: Optional[List[str]] = None):
         self._actor_id = actor_id
         self._class_name = class_name
         self._method_names = list(method_names)
         self._namespace = namespace
         self._max_concurrency = max_concurrency
+        self._has_groups = has_groups
+        self._method_options = dict(method_options or {})
+        self._group_names = list(group_names or [])
 
     @property
     def actor_id(self) -> ActorID:
@@ -234,13 +263,23 @@ class ActorHandle:
         if name not in self._method_names:
             raise AttributeError(
                 f"Actor {self._class_name} has no method {name!r}")
-        return ActorMethod(self, name)
+        mopts = self._method_options.get(name, {})
+        return ActorMethod(
+            self, name,
+            num_returns=mopts.get("num_returns") or 1,
+            concurrency_group=mopts.get("concurrency_group") or "")
 
-    def _submit_method(self, method: str, args, kwargs, num_returns):
+    def _submit_method(self, method: str, args, kwargs, num_returns,
+                       concurrency_group: str = ""):
         rt = _runtime_mod.get_runtime()
         if num_returns in ("streaming", "dynamic"):
             num_returns = TaskSpec.STREAMING
         task_args, kw_keys = _build_args(args, kwargs)
+        if concurrency_group and self._group_names and \
+                concurrency_group not in self._group_names:
+            raise ValueError(
+                f"unknown concurrency group {concurrency_group!r}; "
+                f"declared: {self._group_names}")
         spec = TaskSpec(
             task_id=rt.next_actor_task_id(self._actor_id),
             job_id=rt.job_id,
@@ -253,6 +292,10 @@ class ActorHandle:
             actor_id=self._actor_id,
             seq_no=rt.next_actor_seq(self._actor_id),
             max_concurrency=self._max_concurrency,
+            concurrency_group=concurrency_group,
+            # Grouped actors execute per-group: submission must not
+            # serialize (ref: per-group scheduling queues).
+            unordered=self._has_groups,
             name=f"{self._class_name}.{method}",
         )
         if rt.config.tracing_enabled:
@@ -270,7 +313,9 @@ class ActorHandle:
     def __reduce__(self):
         return (ActorHandle, (self._actor_id, self._class_name,
                               self._method_names, self._namespace,
-                              self._max_concurrency))
+                              self._max_concurrency,
+                              self._has_groups, self._method_options,
+                              self._group_names))
 
 
 class ActorClass:
@@ -328,6 +373,31 @@ class ActorClass:
         res = task_resources(
             opts["num_cpus"], opts["num_tpus"], opts["memory"],
             opts["resources"], default_cpus=1.0)
+        max_concurrency = opts["max_concurrency"]
+        groups = dict(opts["concurrency_groups"] or {})
+        method_options: Dict[str, Dict[str, Any]] = {}
+        for n in method_names:
+            mo = getattr(getattr(self._cls, n, None),
+                         "__rt_method_options__", None)
+            if mo:
+                method_options[n] = dict(mo)
+                g = mo.get("concurrency_group")
+                if g and g not in groups:
+                    raise ValueError(
+                        f"method {n!r} declares concurrency group "
+                        f"{g!r} but the actor only defines "
+                        f"{sorted(groups)} — typo'd group names must "
+                        f"fail at creation, not fall back silently")
+        has_async = any(
+            inspect.iscoroutinefunction(getattr(self._cls, n, None))
+            for n in method_names)
+        if has_async and max_concurrency == 1:
+            # Async actors interleave natively; default their window
+            # like the reference (ref: DEFAULT_MAX_CONCURRENCY_ASYNC
+            # = 1000 for asyncio actors) — including grouped actors,
+            # whose DEFAULT group would otherwise serialize await-
+            # holding methods into a deadlock.
+            max_concurrency = 1000
         spec = TaskSpec(
             task_id=rt.actor_creation_task_id(actor_id),
             job_id=rt.job_id,
@@ -339,7 +409,9 @@ class ActorClass:
             num_returns=1,
             resources=res,
             max_restarts=opts["max_restarts"],
-            max_concurrency=opts["max_concurrency"],
+            max_concurrency=max_concurrency,
+            concurrency_groups=groups,
+            method_options=method_options,
             actor_id=actor_id,
             actor_name=name,
             namespace=opts["namespace"],
@@ -357,7 +429,10 @@ class ActorClass:
                 return rt.get_named_actor(name, opts["namespace"])
             raise
         return ActorHandle(actor_id, self._cls.__name__, method_names,
-                           opts["namespace"], opts["max_concurrency"])
+                           opts["namespace"], max_concurrency,
+                           has_groups=bool(groups),
+                           method_options=method_options,
+                           group_names=sorted(groups))
 
     def __call__(self, *a, **kw):
         raise TypeError(

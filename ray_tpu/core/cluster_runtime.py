@@ -1503,7 +1503,7 @@ class ClusterRuntime(BaseRuntime):
         ActorSubmitQueue in transport/actor_task_submitter.h, redesigned
         around in-order connection delivery)."""
         try:
-            ordered = spec.max_concurrency <= 1
+            ordered = spec.max_concurrency <= 1 and not spec.unordered
             if ordered and spec.max_retries == 0:
                 # Pipelined fast path: the submit lock covers only
                 # dep-resolution + the frame WRITE, so wire order (and
@@ -1655,9 +1655,13 @@ class ClusterRuntime(BaseRuntime):
                              f"{namespace!r}")
         from .api import ActorHandle
 
+        groups = info.get("concurrency_groups") or {}
         return ActorHandle(info["actor_id"], info["class_name"],
                            info["method_names"], namespace,
-                           info.get("max_concurrency", 1))
+                           info.get("max_concurrency", 1),
+                           has_groups=bool(groups),
+                           method_options=info.get("method_options"),
+                           group_names=sorted(groups))
 
     # ------------------------------------------------------------- objects
     def put(self, value: Any) -> ObjectRef:

@@ -375,12 +375,23 @@ class Controller:
         actor = self.actors.get(p["actor_id"])
         if actor is None:
             return None
+        spec = actor.creation_spec
         return {"actor_id": actor.actor_id, "state": actor.state,
                 "worker_addr": actor.worker_addr,
                 "class_name": actor.class_name,
                 "method_names": actor.method_names,
                 "death_reason": actor.death_reason,
-                "max_concurrency": actor.max_concurrency}
+                "max_concurrency": actor.max_concurrency,
+                # Name-lookup handles must keep concurrency-group
+                # routing (a reconstructed handle falling back to the
+                # ordered submit path would reintroduce head-of-line
+                # blocking across groups).
+                "concurrency_groups":
+                    dict(getattr(spec, "concurrency_groups", {}) or {})
+                    if spec is not None else {},
+                "method_options":
+                    dict(getattr(spec, "method_options", {}) or {})
+                    if spec is not None else {}}
 
     async def list_actors(self, _p):
         return [

@@ -73,6 +73,21 @@ class TaskSpec:
     actor_id: Optional[ActorID] = None
     max_restarts: int = 0
     max_concurrency: int = 1
+    # Named concurrency groups (ref: concurrency_group_manager.h:34):
+    # creation specs carry {group: capacity}; actor-task specs carry
+    # the explicit per-call group override ("" = the method's default
+    # group, resolved executor-side).
+    concurrency_groups: Dict[str, int] = field(default_factory=dict)
+    concurrency_group: str = ""
+    # Creation specs: per-method defaults from @ray_tpu.method
+    # ({name: {"concurrency_group": ..., "num_returns": ...}}), so
+    # handles reconstructed by name lookup keep them.
+    method_options: Dict[str, Dict[str, Any]] = field(
+        default_factory=dict)
+    # Actor-task specs: True when the actor executes per concurrency
+    # group — submission must not serialize calls (a dedicated signal;
+    # max_concurrency stays the actor's honest value).
+    unordered: bool = False
     actor_name: str = ""               # named actor registration
     namespace: str = ""
     seq_no: int = 0                    # per-actor submission order

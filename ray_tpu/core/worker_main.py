@@ -509,13 +509,37 @@ class Worker:
         self.actor_executor = ThreadPoolExecutor(
             max_workers=n, thread_name_prefix="actor-exec")
         self._actor_max_concurrency = n
+        # Named concurrency groups (ref: concurrency_group_manager.h:34
+        # + fiber.h): each group gets its OWN thread pool (sync
+        # methods) and asyncio semaphore (async methods), so a slow
+        # group can never starve another — the default group is the
+        # base actor_executor above.  Method -> group defaults come
+        # from @ray_tpu.method annotations on the class.
+        self._group_executors: Dict[str, ThreadPoolExecutor] = {}
+        self._group_sems: Dict[str, asyncio.Semaphore] = {}
+        self._method_groups: Dict[str, str] = {}
+        for gname, cap in (spec.concurrency_groups or {}).items():
+            cap = max(1, int(cap))
+            self._group_executors[gname] = ThreadPoolExecutor(
+                max_workers=cap,
+                thread_name_prefix=f"actor-{gname}")
+            self._group_sems[gname] = asyncio.Semaphore(cap)
+        for mname in spec.method_names:
+            fn = getattr(instance, mname, None)
+            mopts = getattr(fn, "__rt_method_options__", None)
+            if mopts and mopts.get("concurrency_group"):
+                self._method_groups[mname] = mopts["concurrency_group"]
+        self._group_sems[""] = asyncio.Semaphore(n)
         # max_concurrency=1: owners PIPELINE calls (frames arrive before
         # earlier replies are sent), so ordering must be enforced here —
         # one FIFO lock serializing sync and async methods in arrival
         # order (asyncio.Lock wakes waiters FIFO; handler tasks start in
         # frame-arrival order).  Ref: ActorSchedulingQueue in
         # transport/task_receiver.h executing in sequence-number order.
-        self._actor_exec_lock = asyncio.Lock() if n == 1 else None
+        self._actor_exec_lock = (asyncio.Lock()
+                                 if n == 1
+                                 and not self._group_executors
+                                 else None)
         ctl = RpcClient(self.controller_addr,
                         tag=f"actor-{spec.actor_id.hex()[:8]}")
         await ctl.connect()
@@ -555,13 +579,32 @@ class Worker:
                 return await self._run_actor_method(spec, method)
         return await self._run_actor_method(spec, method)
 
+    def _resolve_group(self, spec: TaskSpec) -> str:
+        """Per-call override beats the method's declared group; ""
+        (unknown groups fall back to the default pool with a warning
+        rather than failing the call)."""
+        group = spec.concurrency_group or \
+            self._method_groups.get(spec.method_name, "")
+        if group and group not in self._group_executors:
+            logger.warning("unknown concurrency group %r for %s; "
+                           "using default", group, spec.method_name)
+            return ""
+        return group
+
     async def _run_actor_method(self, spec: TaskSpec, method
                                 ) -> TaskResult:
+        group = self._resolve_group(spec)
         if inspect.iscoroutinefunction(method):
+            sem = self._group_sems.get(group)
+            if sem is not None:
+                async with sem:
+                    return await self._run_async_method(spec, method)
             return await self._run_async_method(spec, method)
+        executor = self._group_executors.get(group,
+                                             self.actor_executor)
         loop = asyncio.get_event_loop()
         return await loop.run_in_executor(
-            self.actor_executor, self._execute_sync, spec, method, None, [])
+            executor, self._execute_sync, spec, method, None, [])
 
     async def _run_async_method(self, spec: TaskSpec, method) -> TaskResult:
         # NOTE: no set_current_task here — the task context is a

@@ -15,13 +15,20 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-AXIS_ORDER = ("data", "fsdp", "expert", "pipeline", "seq", "tensor")
+# ``dcn`` is the outermost (slowest) axis: data-parallel replicas
+# across TPU SLICES communicate over the data-center network, while
+# every axis to its right stays inside a slice on ICI (ref: the
+# multi-slice mesh recipe — gradient all-reduce hierarchically: ICI
+# within a slice, DCN across slices).
+AXIS_ORDER = ("dcn", "data", "fsdp", "expert", "pipeline", "seq",
+              "tensor")
 
 
 @dataclass
 class MeshSpec:
     """Named parallelism degrees; -1 on one axis means "all remaining"."""
 
+    dcn: int = 1
     data: int = 1
     fsdp: int = 1
     expert: int = 1

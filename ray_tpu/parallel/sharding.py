@@ -19,7 +19,7 @@ LogicalAxes = Tuple[Optional[str], ...]
 # hidden/head dims over tensor, sequence over seq (context parallel),
 # experts over expert.
 DEFAULT_RULES: Dict[str, Union[str, Tuple[str, ...], None]] = {
-    "batch": ("data", "fsdp"),
+    "batch": ("dcn", "data", "fsdp"),
     "seq": "seq",
     "embed": None,
     "embed_fsdp": "fsdp",       # param embed dim when FSDP-sharding params

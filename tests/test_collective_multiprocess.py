@@ -1,0 +1,90 @@
+"""Multi-process XLA collective group: >=2 OS processes, each with >=2
+virtual CPU devices, bootstrap jax.distributed through the group's
+KV rendezvous and run an IN-GRAPH psum over the combined 4-device
+mesh (VERDICT r4 #4; ref: the rendezvous role of
+util/collective/collective_group/nccl_collective_group.py done
+TPU-natively via jax.distributed + GSPMD)."""
+
+import os
+import subprocess
+import sys
+
+import ray_tpu
+
+_MEMBER = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+import ray_tpu
+from ray_tpu import collective as col
+
+rank = int(sys.argv[1])
+world = 2
+ray_tpu.init(address={addr!r})
+g = col.init_collective_group(world, rank, backend="xla",
+                              group_name="mpgrp")
+import jax
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# Combined world: 2 processes x 2 local virtual CPU devices.
+assert jax.process_count() == world, jax.process_count()
+assert jax.local_device_count() == 2, jax.local_device_count()
+assert len(g.devices) == 4, g.devices
+
+mesh = g.global_mesh("x")
+assert mesh.devices.size == 4
+
+# IN-GRAPH collective over the combined mesh: each process contributes
+# a host-local shard; jnp.sum over the x-sharded global array compiles
+# to a cross-process all-reduce inside jit.
+local = np.full((2, 3), float(rank + 1), np.float32)  # 2 rows/dev
+garr = multihost_utils.host_local_array_to_global_array(
+    local, mesh, P("x"))
+total = jax.jit(
+    jnp.sum,
+    in_shardings=NamedSharding(mesh, P("x")),
+    out_shardings=NamedSharding(mesh, P()))(garr)
+# Global array rows: 2 procs x 2 rows x 3 cols of (rank+1).
+expect = float(2 * 3 * 1 + 2 * 3 * 2)
+got = float(np.asarray(jax.device_get(total)))
+assert got == expect, (got, expect)
+
+# Eager path over the same world.
+out = col.allreduce(np.arange(4, dtype=np.float32), "mpgrp")
+np.testing.assert_allclose(out, 2 * np.arange(4, dtype=np.float32))
+col.barrier("mpgrp")
+ray_tpu.shutdown()
+print("MEMBER-%d-OK" % rank, flush=True)
+"""
+
+
+def test_xla_group_two_processes_in_graph_psum():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rt = ray_tpu.init(mode="cluster", num_cpus=2)
+    try:
+        addr = rt.controller_addr
+        script = _MEMBER.format(repo=repo, addr=addr)
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ)
+            # Each member is its own jax process with 2 virtual CPU
+            # devices; the combined world is 2x2=4.
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count=2")
+            env.pop("JAX_NUM_PROCESSES", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", script, str(rank)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        outs = []
+        for rank, p in enumerate(procs):
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+            assert p.returncode == 0, f"rank {rank}:\n{out[-3000:]}"
+        for rank in range(2):
+            assert f"MEMBER-{rank}-OK" in outs[rank]
+    finally:
+        ray_tpu.shutdown()

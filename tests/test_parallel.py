@@ -37,8 +37,8 @@ def test_mesh_spec_resolve():
 def test_create_mesh_axes():
     mesh = create_mesh(MeshSpec(data=2, tensor=4))
     assert mesh.shape["data"] == 2 and mesh.shape["tensor"] == 4
-    assert set(mesh.axis_names) == {"data", "fsdp", "expert", "pipeline",
-                                    "seq", "tensor"}
+    assert set(mesh.axis_names) == {"dcn", "data", "fsdp", "expert",
+                                    "pipeline", "seq", "tensor"}
 
 
 def test_sharding_rules_prune():
