@@ -54,7 +54,11 @@ def main() -> None:
     state = jax.device_put(state)
 
     def loss_fn(p, b):
-        return gpt2_loss_fn(cfg, p, b, loss_chunk=0)
+        # 256-wide fused chunked xent (models/gpt2.py _chunked_xent
+        # custom_vjp): measured best on-chip — the whole-logits path
+        # pays ~3.3 GB of fp32 logits traffic per direction.
+        return gpt2_loss_fn(cfg, p, b,
+                            loss_chunk=256 if on_tpu else 0)
 
     one_step = make_train_step(loss_fn, optimizer)
     tokens = jax.random.randint(jax.random.PRNGKey(1),

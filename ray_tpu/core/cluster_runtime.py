@@ -16,6 +16,7 @@ python/ray/_private/node.py:1407 start_head_processes.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 import os
 import subprocess
@@ -117,7 +118,7 @@ class _PooledLease:
     can never deadlock behind a blocked task on the same worker."""
 
     __slots__ = ("lease_id", "agent_addr", "worker_addr", "worker_id",
-                 "chip_ids", "idle_since")
+                 "chip_ids", "idle_since", "dead")
 
     def __init__(self, lease_id, agent_addr, worker_addr, worker_id,
                  chip_ids):
@@ -127,6 +128,7 @@ class _PooledLease:
         self.worker_id = worker_id
         self.chip_ids = chip_ids
         self.idle_since = 0.0
+        self.dead = False
 
 
 class _SchedKeyState:
@@ -137,7 +139,7 @@ class _SchedKeyState:
     lease request per (resource shape, runtime env) class)."""
 
     __slots__ = ("key", "base_payload", "queue", "leases", "idle",
-                 "request_agents")
+                 "request_agents", "repump_scheduled")
 
     def __init__(self, key, base_payload):
         self.key = key
@@ -150,6 +152,7 @@ class _SchedKeyState:
         self.idle: List[_PooledLease] = []
         # request_id -> agent address currently holding that request.
         self.request_agents: Dict[str, str] = {}
+        self.repump_scheduled = False
 
 
 class ClusterRuntime(BaseRuntime):
@@ -225,6 +228,11 @@ class ClusterRuntime(BaseRuntime):
         self._sched_states: Dict[tuple, _SchedKeyState] = {}
         self._lease_sweeper: Optional[asyncio.Task] = None
         self._streams: Dict[str, _StreamState] = {}
+        self._submit_buf: List[tuple] = []
+        self._submit_buf_lock = threading.Lock()
+        # Batched-exec channel: reply_id -> (status_fut, st, pl, item).
+        self._reply_counter = itertools.count(1)
+        self._reply_waiters: Dict[int, tuple] = {}
         self._shutdown_flag = False
         self._event_cursor = 0
         # Worker-role: current lease for blocked-CPU accounting.
@@ -526,6 +534,9 @@ class ClusterRuntime(BaseRuntime):
             cli = RpcClient(addr, tag=self.caller_tag,
                             connect_timeout=10.0)
             cli.on_notify("stream_item", self._on_stream_item)
+            cli.on_notify("task_results", self._on_task_results)
+            cli.on_disconnect(
+                lambda a=addr: self._on_worker_disconnect(a))
             await cli.connect()
             self._worker_clients[addr] = cli
         return cli
@@ -721,15 +732,31 @@ class ClusterRuntime(BaseRuntime):
         sub = _Submission(spec)
         for oid in oids:
             self._submissions[oid] = sub
-        from .rpc import spawn_task
-
-        self.io.call_soon(lambda: spawn_task(
-            self._submit_normal(spec, sub, held), self.io.loop))
+        # Submission coalescing: a burst of .remote() calls from the
+        # user thread wakes the io loop ONCE — the drain callback
+        # spawns every buffered submission (call_soon_threadsafe is a
+        # lock+futex pair per call otherwise; a 300-task batch paid
+        # 300 of them).
+        with self._submit_buf_lock:
+            self._submit_buf.append((spec, sub, held))
+            first = len(self._submit_buf) == 1
+        if first:
+            self.io.call_soon(self._drain_submit_buf)
         if spec.is_streaming:
             from .object_ref import ObjectRefGenerator
 
             return [ObjectRefGenerator(spec.task_id, oids[0])]
         return [ObjectRef(o) for o in oids]
+
+    def _drain_submit_buf(self) -> None:
+        """Io loop: spawn every submission buffered since the wakeup."""
+        from .rpc import spawn_task
+
+        with self._submit_buf_lock:
+            batch, self._submit_buf = self._submit_buf, []
+        for spec, sub, held in batch:
+            spawn_task(self._submit_normal(spec, sub, held),
+                       self.io.loop)
 
     async def _submit_normal(self, spec: TaskSpec,
                              sub: Optional[_Submission] = None,
@@ -796,6 +823,11 @@ class ClusterRuntime(BaseRuntime):
                 self._fail_returns(spec, TaskError.from_exception(e.cause))
                 return
             if not result.ok:
+                if getattr(result, "requeue", False):
+                    # Direct-path push landed on a worker whose running
+                    # task is blocked: resubmit through a fresh lease.
+                    await asyncio.sleep(0.01)
+                    continue
                 err = result.error
                 if spec.is_streaming:
                     self._finalize_stream(spec, result)
@@ -940,7 +972,8 @@ class ClusterRuntime(BaseRuntime):
 
             self._lease_sweeper = spawn_task(self._lease_sweep_loop())
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        st.queue.append((spec, sub, fut))
+        st.queue.append((spec, sub, fut,
+                         asyncio.get_event_loop().time()))
         self._pump_key(st)
         waiters = [asyncio.ensure_future(fut),
                    asyncio.ensure_future(sub.cancel_event.wait())]
@@ -966,36 +999,160 @@ class ClusterRuntime(BaseRuntime):
                 break
             pl = st.idle.pop()
             spawn_task(self._lease_worker_loop(st, pl, item))
-        want = min(len(st.queue), self.config.lease_request_limit)
+        # Request NEW capacity only for items no about-to-idle lease
+        # picked up within a beat (10ms) — a sequential caller's next
+        # task otherwise races the lease loop's idle-append and spawns
+        # a spurious lease request (and often a brand-new worker) per
+        # call.  With no leases at all, request immediately (cold
+        # start must not wait); the sweeper re-pumps every 100ms so
+        # genuine backlog still scales out.
+        if st.leases:
+            now = asyncio.get_event_loop().time()
+            # FIFO queue => enqueue times are ascending: the aged
+            # items are a PREFIX, so stop at the first young one (and
+            # at the request cap) — a full scan per submission would
+            # be O(queue) and quadratic over a deep backlog.
+            aged = 0
+            cap = self.config.lease_request_limit
+            for entry in st.queue:
+                if now - entry[3] <= 0.01:
+                    break
+                if not entry[2].done():
+                    aged += 1
+                    if aged >= cap:
+                        break
+        else:
+            aged = len(st.queue)
+        want = min(aged, self.config.lease_request_limit)
         while len(st.request_agents) < want:
             rid = uuid.uuid4().hex
             st.request_agents[rid] = self.agent_addr
             spawn_task(self._request_pool_lease(st, rid))
+        if aged < len(st.queue) and not st.repump_scheduled:
+            # Some items are inside the request grace: re-pump just
+            # after it expires so scale-out requests go out BEFORE the
+            # (longer) pipeline grace lets a busy lease steal them —
+            # fresh workers must win for long tasks to stay parallel.
+            st.repump_scheduled = True
 
-    def _next_queued(self, st: _SchedKeyState):
+            def _repump():
+                st.repump_scheduled = False
+                if st.queue:
+                    self._pump_key(st)
+
+            asyncio.get_event_loop().call_later(0.015, _repump)
+
+    def _next_queued(self, st: _SchedKeyState, min_age: float = 0.0):
+        """Pop the next live queue item; with ``min_age``, only items
+        queued at least that long (pipelining waits out the grace
+        window so fresh lease grants keep long tasks parallel)."""
+        now = asyncio.get_event_loop().time()
         while st.queue:
-            spec, sub, fut = st.queue.popleft()
+            head = st.queue[0]
+            spec, sub, fut, t_enq = head
             if fut.done():
+                st.queue.popleft()
                 continue
             if sub.cancelled:
+                st.queue.popleft()
                 fut.set_exception(_CancelledInFlight())
                 continue
-            return spec, sub, fut
+            if min_age > 0.0 and now - t_enq < min_age:
+                # Young item: hold it for a FRESH lease (the
+                # delayed re-pump requests capacity at ~15ms; a
+                # busy lease may only steal items older than
+                # the pipeline grace).
+                return None
+            st.queue.popleft()
+            return spec, sub, fut, t_enq
         return None
 
     async def _lease_worker_loop(self, st: _SchedKeyState,
                                  pl: _PooledLease, item=None) -> None:
-        """Feed queued tasks to one leased worker, one at a time (ref:
-        OnWorkerIdle, normal_task_submitter.h:144)."""
+        """Feed queued tasks to one leased worker with up to
+        ``lease_pipeline_depth`` pushes in flight (ref: OnWorkerIdle +
+        pipelining, normal_task_submitter.h:144).  The worker runs one
+        task at a time from an explicit queue and hands back queued
+        tasks if its running task blocks — a requeued item goes to
+        the front of the owner queue for another lease."""
+        from .rpc import spawn_task
+
+        depth = max(1, self.config.lease_pipeline_depth)
+        grace = self.config.lease_pipeline_grace_ms / 1000.0
+        inflight: set = set()
+        stalled = False   # worker reported blocked: stop feeding it
+        stall_round = 0
         while True:
-            if item is None:
-                item = self._next_queued(st)
-            if item is None:
+            batch = []
+            while not pl.dead and not stalled \
+                    and len(inflight) + len(batch) < depth:
+                if item is not None:
+                    nxt, item = item, None
+                else:
+                    # The FIRST task takes this worker immediately;
+                    # extras pipeline only after the grace window (a
+                    # fresh lease grant should claim young items so
+                    # long tasks stay parallel).
+                    nxt = self._next_queued(
+                        st, min_age=0.0 if not (inflight or batch)
+                        else grace)
+                if nxt is None:
+                    break
+                batch.append(nxt)
+            if batch:
+                inflight.update(await self._exec_batch_send(
+                    st, pl, batch))
+            if not inflight:
+                if pl.dead:
+                    self._pump_key(st)
+                    return
+                if stalled:
+                    # The worker is blocked on a task pushed by some
+                    # OTHER owner: back off before probing again (an
+                    # immediate probe would requeue-spin a hot notify
+                    # loop against the blocked worker).
+                    stalled = False
+                    await asyncio.sleep(
+                        min(0.005 * (2 ** min(stall_round, 5)), 0.1))
+                    stall_round += 1
+                    continue
                 pl.idle_since = asyncio.get_event_loop().time()
                 st.idle.append(pl)
                 return
-            spec, sub, fut = item
-            item = None
+            if len(inflight) < depth and st.queue:
+                # Head item still inside its grace window: re-check
+                # shortly instead of sleeping until a push completes.
+                done, inflight = await asyncio.wait(
+                    inflight, timeout=grace,
+                    return_when=asyncio.FIRST_COMPLETED)
+            else:
+                done, inflight = await asyncio.wait(
+                    inflight, return_when=asyncio.FIRST_COMPLETED)
+            statuses = {t.result() for t in done}
+            if "requeue" in statuses:
+                # A requeue in the batch wins over any "ok" from the
+                # same round: the worker IS blocked right now, and an
+                # arbitrary set-iteration order must not un-stall us
+                # into bouncing more work off it.
+                stalled = True
+            elif "ok" in statuses:
+                stalled = False
+                stall_round = 0
+
+    async def _exec_batch_send(self, st: _SchedKeyState,
+                               pl: _PooledLease, items) -> list:
+        """Ship a batch of tasks to a leased worker as ONE notify
+        frame; per-item results come back batched as task_results
+        notifies (ref: the push/report split in core_worker.proto —
+        batching amortizes frame encode, syscalls, and context
+        switches across the batch).  Returns one status future per
+        item resolving to "ok" | "requeue" | "dead"."""
+        loop = asyncio.get_event_loop()
+        rfuts = []
+        payload_tasks = []
+        for item in items:
+            spec, sub, fut, _t = item
+            rid = next(self._reply_counter)
             sub.agent_addr = pl.agent_addr
             sub.worker_addr = pl.worker_addr
             sub.worker_id = pl.worker_id
@@ -1004,25 +1161,77 @@ class ClusterRuntime(BaseRuntime):
                 stream = self._streams.get(spec.task_id.hex())
                 if stream is not None:
                     stream.worker_addr = pl.worker_addr
-            try:
-                worker = await self._worker_client(pl.worker_addr)
-                reply = await worker.call("push_task", {
-                    "spec": spec, "chip_ids": pl.chip_ids,
-                    "lease_id": pl.lease_id,
-                    "caller_tag": self.caller_tag})
-            except Exception as e:  # noqa: BLE001 — relayed to waiter
-                # Worker or its node failed mid-push: this lease is
-                # unusable.  Tell the agent (best effort) so the CPU
-                # frees even if the worker process is only wedged, and
-                # let the failed task's own retry loop resubmit.
-                st.leases.pop(pl.lease_id, None)
-                self._return_lease_async(pl, worker_failed=True)
-                if not fut.done():
-                    fut.set_exception(e)
+            rfut = loop.create_future()
+            self._reply_waiters[rid] = ("pool", rfut, st, pl, item)
+            payload_tasks.append({"spec": spec, "reply_id": rid})
+            rfuts.append(rfut)
+        try:
+            worker = await self._worker_client(pl.worker_addr)
+            await worker.notify("exec_batch", {
+                "tasks": payload_tasks, "lease_id": pl.lease_id,
+                "chip_ids": pl.chip_ids,
+                "caller_tag": self.caller_tag})
+        except Exception:  # noqa: BLE001 — handled as a dead lease
+            self._on_worker_disconnect(pl.worker_addr)
+        return rfuts
+
+    def _on_task_results(self, payload: Dict) -> None:
+        """Io loop: batched results from a leased worker."""
+        for rid, res in payload["results"]:
+            ent = self._reply_waiters.pop(rid, None)
+            if ent is None:
+                continue
+            if ent[0] == "actor":
+                _kind, afut, _addr = ent
+                if not afut.done():
+                    afut.set_result(res)
+                continue
+            _kind, rfut, st, pl, item = ent
+            spec, sub, fut, _t = item
+            if getattr(res, "requeue", False):
+                # The worker's running task blocked in get(): fail
+                # over to another lease, keeping rough order.
+                st.queue.appendleft(item)
+                sub.pushed = False
                 self._pump_key(st)
-                return
+                if not rfut.done():
+                    rfut.set_result("requeue")
+                continue
             if not fut.done():
-                fut.set_result(reply)
+                fut.set_result(res)
+            if not rfut.done():
+                rfut.set_result("ok")
+
+    def _on_worker_disconnect(self, addr: str) -> None:
+        """Io loop: a leased worker's connection died — fail its
+        in-flight batched tasks (their submit loops retry) and release
+        the lease."""
+        err = RpcError(f"connection to {addr} lost")
+        to_pump = {}
+        for rid, ent in list(self._reply_waiters.items()):
+            if ent[0] == "actor":
+                _kind, afut, a_addr = ent
+                if a_addr == addr:
+                    self._reply_waiters.pop(rid, None)
+                    if not afut.done():
+                        afut.set_exception(err)
+                continue
+            _kind, rfut, st, pl, item = ent
+            if pl.worker_addr != addr:
+                continue
+            self._reply_waiters.pop(rid, None)
+            if not pl.dead:
+                pl.dead = True
+                st.leases.pop((pl.agent_addr, pl.lease_id), None)
+                self._return_lease_async(pl, worker_failed=True)
+            spec, sub, fut, _t = item
+            if not fut.done():
+                fut.set_exception(err)
+            if not rfut.done():
+                rfut.set_result("dead")
+            to_pump[id(st)] = st
+        for st in to_pump.values():
+            self._pump_key(st)
 
     async def _request_pool_lease(self, st: _SchedKeyState,
                                   rid: str) -> None:
@@ -1054,7 +1263,13 @@ class ClusterRuntime(BaseRuntime):
                               grant["worker_addr"],
                               grant.get("worker_id"),
                               grant.get("chip_ids", []))
-            st.leases[pl.lease_id] = pl
+            logger.debug("pool lease %s granted by %s (worker %s)",
+                         pl.lease_id, agent_addr, grant["worker_addr"])
+            # Keyed by (agent, id): lease ids are per-agent counters —
+            # two agents both granting "lease 1" must not collide in
+            # the pool (a collision silently leaks the overwritten
+            # lease's CPU on its agent FOREVER; found via chaos test).
+            st.leases[(pl.agent_addr, pl.lease_id)] = pl
             pl.idle_since = asyncio.get_event_loop().time()
             st.idle.append(pl)
             st.request_agents.pop(rid, None)
@@ -1070,7 +1285,7 @@ class ClusterRuntime(BaseRuntime):
             if st.leases or st.request_agents:
                 return
             while st.queue:
-                _spec, _sub, fut = st.queue.popleft()
+                _spec, _sub, fut, _t = st.queue.popleft()
                 if not fut.done():
                     fut.set_exception(e)
         finally:
@@ -1096,8 +1311,11 @@ class ClusterRuntime(BaseRuntime):
                 await agent.call("return_lease", {
                     "lease_id": pl.lease_id,
                     "worker_failed": worker_failed})
-            except (RpcError, RemoteCallError):
-                pass  # agent gone; its ledger died with it
+                logger.debug("returned lease %s to %s (failed=%s)",
+                             pl.lease_id, pl.agent_addr, worker_failed)
+            except (RpcError, RemoteCallError) as e:
+                logger.debug("return of lease %s to %s failed: %r",
+                             pl.lease_id, pl.agent_addr, e)
 
         spawn_task(_ret(), self.io.loop)
 
@@ -1115,6 +1333,10 @@ class ClusterRuntime(BaseRuntime):
             now = asyncio.get_event_loop().time()
             ttl = self.config.lease_keepalive_s
             for key, st in list(self._sched_states.items()):
+                if st.queue:
+                    # Re-pump: items past the request grace get their
+                    # scale-out lease requests here.
+                    self._pump_key(st)
                 # Queue size BEYOND in-flight lease requests (each
                 # queued request already stands for one task in the
                 # agent's demand vector).
@@ -1136,7 +1358,8 @@ class ClusterRuntime(BaseRuntime):
                     for pl in [p for p in st.idle
                                if now - p.idle_since > ttl]:
                         st.idle.remove(pl)
-                        st.leases.pop(pl.lease_id, None)
+                        st.leases.pop((pl.agent_addr, pl.lease_id),
+                                      None)
                         self._return_lease_async(pl)
                 if not st.queue and not st.leases \
                         and not st.request_agents:
@@ -1551,9 +1774,18 @@ class ClusterRuntime(BaseRuntime):
                         ssub.worker_addr = info["worker_addr"]
                         ssub.pushed = True
                 worker = await self._worker_client(info["worker_addr"])
-                fut = worker.call_nowait("push_actor_task", {
-                    "spec": spec, "caller_id": self._runtime_id,
-                    "caller_tag": self.caller_tag})
+                rid = next(self._reply_counter)
+                fut = asyncio.get_event_loop().create_future()
+                self._reply_waiters[rid] = (
+                    "actor", fut, info["worker_addr"])
+                try:
+                    worker.notify_nowait("exec_actor", {
+                        "spec": spec, "reply_id": rid,
+                        "caller_id": self._runtime_id,
+                        "caller_tag": self.caller_tag})
+                except RpcError:
+                    self._reply_waiters.pop(rid, None)
+                    fut = None
             except RpcError:
                 fut = None  # dial failed: serial path refreshes state
             if fut is None:
@@ -1684,9 +1916,18 @@ class ClusterRuntime(BaseRuntime):
         self.memory.put(oid, _StoreRef(size))
         return ObjectRef(oid)
 
+    # Worker-role callback (set by worker_main): fired when the
+    # executing task blocks/unblocks in get().
+    on_block = None
+
     def _notify_blocked(self, blocked: bool) -> None:
         """Worker-role hook: release/reacquire lease CPU while blocked in
         get (driver has no lease; no-op)."""
+        if self.on_block is not None:
+            try:
+                self.on_block(blocked)
+            except Exception:
+                pass
         lease_id = self.current_lease_id
         if lease_id is None:
             return
@@ -1855,6 +2096,18 @@ class ClusterRuntime(BaseRuntime):
         if blocked:
             self._notify_blocked(True)
         try:
+            if len(needs_wait) > 1:
+                # One shared wait for the whole batch (see
+                # MemoryStore.wait_for_many) — but only for refs whose
+                # results arrive THROUGH the memory store (our own
+                # pending returns); plane refs resolve via pulls below.
+                with self._pending_lock:
+                    batched = [o for o in needs_wait
+                               if o in self._pending_returns]
+                if len(batched) > 1:
+                    remaining = (max(deadline - time.monotonic(), 0.0)
+                                 if deadline is not None else None)
+                    self.memory.wait_for_many(batched, remaining)
             out = []
             for r in refs:
                 remaining = None
@@ -2012,9 +2265,10 @@ class ClusterRuntime(BaseRuntime):
         a warning is emitted, matching the surfaced-gap contract."""
         sub = self._submissions.get(ref.id)
         if sub is None or sub.done:
-            import logging
-
-            logging.getLogger("ray_tpu").warning(
+            # Debug, not warning: bulk cancellation sweeps routinely
+            # race completion by design (100k-queue benchmarks would
+            # emit 100k log lines at warning level).
+            logger.debug(
                 "cancel(%s): no in-flight submission (already finished, "
                 "unknown, or an actor task — not cancellable)", ref)
             return

@@ -143,6 +143,17 @@ define_flag("lease_keepalive_s", float, 0.5,
             "returning it to the node agent (ref: "
             "normal_task_submitter.h:74 lease_timeout_ms_ — lease "
             "reuse removes the per-task lease round-trip).")
+define_flag("lease_pipeline_depth", int, 8,
+            "In-flight task pushes per leased worker (ref: pipelining "
+            "in normal_task_submitter.h).  The worker executes one at "
+            "a time from an explicit queue and RETURNS queued tasks "
+            "when its running task blocks in get(), so depth > 1 "
+            "cannot deadlock nested tasks.")
+define_flag("lease_pipeline_grace_ms", int, 25,
+            "How long a queued task waits for a FRESH lease before it "
+            "may pipeline behind a busy leased worker — preserves "
+            "parallelism for long tasks (new workers claim young "
+            "items) while a saturated queue still pipelines deep.")
 define_flag("lease_request_limit", int, 10,
             "Max concurrent outstanding lease requests per scheduling "
             "key (resource shape + runtime env) per owner (ref: "

@@ -162,6 +162,20 @@ class NodeAgent:
         # task with its await stack (coroutine-level triage the
         # faulthandler thread dump can't see).
         def _dump_tasks(*_a):
+            logger.error(
+                "SCHEDSTATE pending=%d workers=%d idle_q=%d "
+                "starting=%d spawns=%d available=%s total=%s "
+                "leases=%s free_chips=%s by_env=%s acq=%s",
+                len(self.pending), len(self.workers),
+                len(self._idle_q), self._starting_workers,
+                len(getattr(self, "_pending_spawns", {})),
+                dict(self.available.amounts),
+                dict(self.total.amounts),
+                {lid: dict(l.resources.amounts)
+                 for lid, l in self.leases.items()},
+                self.free_chips,
+                dict(getattr(self, "_starting_by_env", {})),
+                dict(getattr(self, "_acquirers_by_env", {})))
             for t in asyncio.all_tasks():
                 # Walk the cr_await chain so nested handler coroutines
                 # show their INNERMOST suspension point, not just the
@@ -246,6 +260,13 @@ class NodeAgent:
                            for req in self.pending][:100]
                 demands += self._backlog_demands()
                 demands += list(getattr(self, "_infeasible", []))[:100]
+                if self.pending:
+                    # Self-healing dispatch tick: a request requeued
+                    # after a failed worker acquire has no event left
+                    # to kick it; retry on the heartbeat cadence (ref:
+                    # the raylet re-running ScheduleAndDispatchTasks
+                    # periodically, node_manager.cc).
+                    self._kick_scheduler()
                 r = await self._ctl.call("heartbeat", {
                     "node_id": self.node_id,
                     "available": {k: max(v, 0.0) for k, v in
@@ -385,6 +406,10 @@ class NodeAgent:
         self.workers.pop(w.worker_id, None)
         if w in self._idle_q:
             self._idle_q.remove(w)
+        # A death frees a pool slot: waiters in _acquire_worker must
+        # re-evaluate their spawn budget or they sleep out their full
+        # timeout while the pool sits empty.
+        self._worker_ready.set()
         if w.lease_id is not None and w.lease_id in self.leases:
             self._release_lease(self.leases[w.lease_id], worker_back=False)
         if prev_state == "actor" and w.actor_id is not None:
@@ -401,6 +426,12 @@ class NodeAgent:
     def _spawn_worker(self, runtime_env: Optional[Dict] = None) -> None:
         env = dict(os.environ)
         env.update(self.config.env_overrides())
+        if int(self.total.get("TPU")) == 0:
+            # CPU-only node: drop the axon TPU-relay trigger so the
+            # image's sitecustomize doesn't preload jax into every
+            # worker (~2s of a ~2.8s spawn measured) — tasks that
+            # import jax still get the CPU backend.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         env_hash = ""
         if runtime_env:
             env_hash = runtime_env.get("hash", "")
@@ -606,7 +637,14 @@ class NodeAgent:
                     continue
                 starting = getattr(self, "_starting_by_env", {}) \
                     .get(want, 0)
-                active = len(self.workers) + self._starting_workers
+                # Actor-dedicated workers live outside the pool cap —
+                # the cap bounds the REUSABLE task pool; actors scale
+                # to memory (OOM monitor guards), matching the
+                # reference where maximum_startup_concurrency limits
+                # spawn rate, not actor count (ref: worker_pool.cc).
+                active = sum(1 for w in self.workers.values()
+                             if w.state != "actor") \
+                    + self._starting_workers
                 if starting < acq[want]:
                     if active >= self._max_workers():
                         # Pool full of mismatched-env workers: retire an
@@ -617,8 +655,8 @@ class NodeAgent:
                         if victim is not None:
                             self._idle_q.remove(victim)
                             await self._retire_worker(victim)
-                    if len(self.workers) + self._starting_workers \
-                            < self._max_workers():
+                            active -= 1
+                    if active < self._max_workers():
                         self._spawn_worker(runtime_env)
                 self._worker_ready.clear()
                 remaining = deadline - asyncio.get_event_loop().time()

@@ -689,16 +689,28 @@ class MemoryStore:
     def __init__(self):
         self._values: Dict[ObjectID, Any] = {}
         self._waiting: Dict[ObjectID, _PendingEntry] = {}
+        # Group waiters (wait_for_many): oid -> [{missing:set, event}]
+        self._many_waiters: Dict[ObjectID, list] = {}
         self._lock = threading.Lock()
 
     def put(self, oid: ObjectID, value: Any) -> None:
+        fire = None
         with self._lock:
             self._values[oid] = value
             ent = self._waiting.pop(oid, None)
+            group = self._many_waiters.pop(oid, None)
+            if group:
+                for state in group:
+                    state["missing"].discard(oid)
+                    if not state["missing"]:
+                        fire = fire or []
+                        fire.append(state["event"])
         if ent is not None:
             ent.value = value
             ent.has_value = True
             ent.event.set()
+        for ev in fire or ():
+            ev.set()
 
     def get_nowait(self, oid: ObjectID) -> Tuple[bool, Any]:
         with self._lock:
@@ -709,6 +721,38 @@ class MemoryStore:
     def contains(self, oid: ObjectID) -> bool:
         with self._lock:
             return oid in self._values
+
+    def wait_for_many(self, oids, timeout: Optional[float]) -> None:
+        """Block until EVERY id is present — one shared event set by
+        the last arrival instead of a futex wait per ref (a 300-ref
+        batched get costs ~2 thread wakeups, not ~300)."""
+        import threading as _threading
+
+        missing: set
+        with self._lock:
+            missing = {o for o in oids if o not in self._values}
+            if not missing:
+                return
+            done = _threading.Event()
+            state = {"missing": missing, "event": done}
+            for o in missing:
+                self._many_waiters.setdefault(o, []).append(state)
+        if not done.wait(timeout):
+            with self._lock:
+                # Unregister or the state dicts leak under every
+                # still-missing oid across repeated polling gets.
+                for o in list(state["missing"]):
+                    group = self._many_waiters.get(o)
+                    if group is not None:
+                        try:
+                            group.remove(state)
+                        except ValueError:
+                            pass
+                        if not group:
+                            self._many_waiters.pop(o, None)
+            raise GetTimeoutError(
+                f"{len(state['missing'])} of {len(list(oids))} objects "
+                f"not ready within {timeout}s")
 
     def wait_for(self, oid: ObjectID, timeout: Optional[float]) -> Any:
         with self._lock:

@@ -61,6 +61,23 @@ def _encode_frame(msg: Tuple) -> bytes:
     return len(data).to_bytes(8, "little") + data
 
 
+def _encode_frame_fast(msg: Tuple) -> bytes:
+    """Server->client frames (responses, result/stream notifies): try
+    the C pickler first — ~3x cheaper than cloudpickle on the hot
+    control frames.  Safety: plain pickle serializes importable
+    objects BY REFERENCE exactly like cloudpickle does, and anything
+    pickle rejects (closures, __main__ definitions not importable
+    here) falls back to cloudpickle — so this path introduces no new
+    cross-process failure modes; client->server REQUESTS keep
+    cloudpickle because driver-__main__ objects pickle by name there
+    and would dangle on the worker."""
+    try:
+        data = pickle.dumps(msg, protocol=5)
+    except Exception:
+        data = cloudpickle.dumps(msg, protocol=5)
+    return len(data).to_bytes(8, "little") + data
+
+
 _BACKGROUND_TASKS: set = set()
 
 
@@ -131,7 +148,8 @@ class RpcServer:
         if writer is None:
             return False
         try:
-            writer.write(_encode_frame((_NOTIFY, 0, method, payload)))
+            writer.write(
+                _encode_frame_fast((_NOTIFY, 0, method, payload)))
             return True
         except (ConnectionError, OSError, RuntimeError):
             self._conns.pop(tag, None)
@@ -162,7 +180,45 @@ class RpcServer:
     async def _serve_conn(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
         peer_tag = f"conn-{next(self._conn_counter)}"
-        write_lock = asyncio.Lock()
+        # Reply-write coalescing: responses produced in the same event-
+        # loop burst join ONE transport write (a pipelined client would
+        # otherwise cost a syscall per reply; the flush runs via
+        # call_soon AFTER the currently-ready handler callbacks).
+        out_buf: list = []
+        out_bytes = [0]
+        flush_pending = [False]
+
+        async def _flush():
+            flush_pending[0] = False
+            if not out_buf:
+                return
+            data = b"".join(out_buf)
+            out_buf.clear()
+            out_bytes[0] = 0
+            try:
+                writer.write(data)
+                await writer.drain()
+            except (ConnectionError, RuntimeError, OSError) as e:
+                logger.debug("srv flush dropped: %r", e)
+
+        loop = asyncio.get_event_loop()
+
+        def send_frame(frame: bytes) -> None:
+            out_buf.append(frame)
+            out_bytes[0] += len(frame)
+            if not flush_pending[0]:
+                flush_pending[0] = True
+                loop.call_soon(lambda: spawn_task(_flush()))
+
+        async def send_frame_bp(frame: bytes) -> None:
+            """send_frame + backpressure: a handler producing bulk
+            replies awaits the flush once the coalescing buffer
+            swells, so a slow-reading peer bounds memory here instead
+            of growing out_buf without limit."""
+            send_frame(frame)
+            if out_bytes[0] > (8 << 20):
+                await _flush()
+
         try:
             while True:
                 try:
@@ -179,7 +235,7 @@ class RpcServer:
                     spawn_task(self._dispatch_notify(method, payload))
                     continue
                 spawn_task(self._dispatch(method, payload, req_id,
-                                          writer, write_lock))
+                                          send_frame, send_frame_bp))
         finally:
             self._conns.pop(peer_tag, None)
             if self._conn_lost_cb is not None:
@@ -205,8 +261,7 @@ class RpcServer:
             logger.exception("notify handler %s failed", method)
 
     async def _dispatch(self, method: str, payload: Any, req_id: int,
-                        writer: asyncio.StreamWriter,
-                        write_lock: asyncio.Lock) -> None:
+                        send_frame, send_frame_bp=None) -> None:
         fn = self._handlers.get(method)
         try:
             if fn is None:
@@ -216,19 +271,19 @@ class RpcServer:
             if asyncio.iscoroutine(result):
                 result = await result
             logger.debug("srv reply %s#%d", method, req_id)
-            frame = _encode_frame((_RESPONSE, req_id, method, result))
+            frame = _encode_frame_fast((_RESPONSE, req_id, method,
+                                        result))
         except BaseException as e:  # noqa: BLE001 — shipped to caller
             try:
-                frame = _encode_frame((_ERROR, req_id, method, e))
+                frame = _encode_frame_fast((_ERROR, req_id, method, e))
             except Exception:
                 frame = _encode_frame(
                     (_ERROR, req_id, method, RuntimeError(repr(e))))
         try:
-            async with write_lock:
-                writer.write(frame)
-                await writer.drain()
-            logger.debug("srv sent %s#%d (%d bytes)", method, req_id,
-                         len(frame))
+            if send_frame_bp is not None and len(frame) > (256 << 10):
+                await send_frame_bp(frame)
+            else:
+                send_frame(frame)
         except (ConnectionError, RuntimeError) as e:
             # Peer went away; the reply has nowhere to go.
             logger.debug("srv reply %s#%d dropped: %r", method, req_id, e)
@@ -269,9 +324,20 @@ class RpcClient:
         # us (stream items, batched results); handlers are plain
         # callables run inline on the read loop — keep them fast.
         self._notify_handlers: Dict[str, Callable[[Any], None]] = {}
+        self._disconnect_cbs: list = []
+        # Write coalescing (mirror of the server side): frames from
+        # one event-loop burst join a single transport write.
+        self._out_buf: list = []
+        self._flush_pending = False
 
     def on_notify(self, method: str, fn: Callable[[Any], None]) -> None:
         self._notify_handlers[method] = fn
+
+    def on_disconnect(self, cb: Callable[[], None]) -> None:
+        """cb() fires when the connection's read loop ends — the hook
+        one-way (notify-based) protocols use to fail their in-flight
+        work, since they have no response future to error."""
+        self._disconnect_cbs.append(cb)
 
     async def connect(self) -> None:
         async with self._lock:
@@ -333,12 +399,44 @@ class RpcClient:
             self._fail_pending(RpcError(f"connection to {self.address} lost"))
             self._writer = None
             self._reader = None
+            for cb in self._disconnect_cbs:
+                try:
+                    cb()
+                except Exception:
+                    logger.exception("disconnect callback failed")
 
     def _fail_pending(self, err: Exception) -> None:
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(err)
         self._pending.clear()
+
+    def _write_frame(self, frame: bytes) -> None:
+        """Buffered write: the actual transport write happens once per
+        event-loop burst (call_soon), so a pipelined burst of calls
+        costs one syscall, not one per frame."""
+        if self._writer is None:
+            raise RpcError(f"not connected to {self.address}")
+        self._out_buf.append(frame)
+        if not self._flush_pending:
+            self._flush_pending = True
+            asyncio.get_event_loop().call_soon(
+                lambda: self._flush_writes(raise_errors=False))
+
+    def _flush_writes(self, raise_errors: bool = True) -> None:
+        self._flush_pending = False
+        if not self._out_buf or self._writer is None:
+            self._out_buf.clear()
+            return
+        data = b"".join(self._out_buf)
+        self._out_buf.clear()
+        try:
+            self._writer.write(data)
+        except (ConnectionError, OSError, RuntimeError):
+            if raise_errors:
+                raise
+            # Deferred (call_nowait) flush: the read loop notices the
+            # dead connection and fails the pending futures.
 
     async def call(self, method: str, payload: Any = None,
                    timeout: Optional[float] = None) -> Any:
@@ -348,13 +446,17 @@ class RpcClient:
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[req_id] = fut
         try:
-            assert self._writer is not None
             logger.debug("cli send %s#%d -> %s [%x]", method, req_id,
                          self.address, id(self))
-            self._writer.write(
+            self._write_frame(
                 _encode_frame((_REQUEST, req_id, method, payload)))
+            # Flush NOW so drain applies to THIS frame and write
+            # errors surface here (the deferred flush is only for
+            # call_nowait pipelining, whose contract is that failures
+            # surface via the read loop).
+            self._flush_writes()
             await self._writer.drain()
-        except (ConnectionError, OSError, AssertionError) as e:
+        except (ConnectionError, OSError, AttributeError) as e:
             self._pending.pop(req_id, None)
             raise RpcError(f"send to {self.address} failed: {e}") from e
         if timeout:
@@ -375,12 +477,19 @@ class RpcClient:
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[req_id] = fut
         try:
-            self._writer.write(
+            self._write_frame(
                 _encode_frame((_REQUEST, req_id, method, payload)))
         except (ConnectionError, OSError) as e:
             self._pending.pop(req_id, None)
             raise RpcError(f"send to {self.address} failed: {e}") from e
         return fut
+
+    def notify_nowait(self, method: str, payload: Any = None) -> None:
+        """Synchronous NOTIFY write (coalesced; failures surface via
+        the read loop / on_disconnect) — the ordered-actor submission
+        path relies on write order == call order."""
+        self._write_frame(
+            _encode_frame((_NOTIFY, 0, method, payload)))
 
     async def drain(self) -> None:
         """Apply transport backpressure after call_nowait bursts."""
@@ -393,11 +502,12 @@ class RpcClient:
     async def notify(self, method: str, payload: Any = None) -> None:
         if self._writer is None:
             await self.connect()
-        assert self._writer is not None
         try:
-            self._writer.write(_encode_frame((_NOTIFY, 0, method, payload)))
+            self._write_frame(
+                _encode_frame((_NOTIFY, 0, method, payload)))
+            self._flush_writes()
             await self._writer.drain()
-        except (ConnectionError, OSError) as e:
+        except (ConnectionError, OSError, AttributeError) as e:
             raise RpcError(f"notify to {self.address} failed: {e}") from e
 
     @property

@@ -148,3 +148,7 @@ class TaskResult:
     # Streaming tasks: how many items were yielded before completion
     # (items themselves travel as stream_item notifies).
     streamed: int = 0
+    # True: the worker returned the task UNEXECUTED (its current task
+    # blocked in get(), so queued work must fail over to another
+    # worker instead of deadlocking behind it) — the owner re-enqueues.
+    requeue: bool = False

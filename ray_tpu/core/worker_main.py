@@ -81,7 +81,18 @@ class Worker:
         self._stream_callers: Dict[str, str] = {}
         self._stream_acks: Dict[str, Dict[str, Any]] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
-        for name in ["push_task", "create_actor", "push_actor_task",
+        # Pipelined normal-task queue (see push_task).
+        from collections import deque as _deque
+
+        self._task_queue: "_deque" = _deque()
+        self._task_runner: Optional[asyncio.Task] = None
+        self._task_running = False
+        self._exec_blocked = False
+        # Batched-exec result buffer: caller_tag -> [(reply_id, res)].
+        self._result_buf: Dict[str, list] = {}
+        self._flush_scheduled = False
+        for name in ["push_task", "exec_batch", "create_actor",
+                     "push_actor_task", "exec_actor",
                      "cancel_task", "ping", "exit", "dump_stack",
                      "profile", "stream_ack"]:
             self.server.register(name, getattr(self, name))
@@ -95,6 +106,7 @@ class Worker:
                       "controller": self.controller_addr,
                       "agent": self.agent_addr},
             _job_id=JobID.from_int(0))
+        self.runtime.on_block = self._on_exec_block
         runtime_mod.set_runtime(self.runtime)
         await self._setup_runtime_env()
         agent = RpcClient(self.agent_addr,
@@ -441,14 +453,155 @@ class Worker:
                 task_id=spec.task_id, ok=False,
                 error=TaskError.from_exception(
                     RuntimeEnvSetupError(env_err)))
-        fn = self._load_func(spec)
         if spec.is_streaming:
             self._stream_callers[spec.task_id.hex()] = \
                 p.get("caller_tag", "")
+        # Owners pipeline several pushes onto one leased worker (ref:
+        # normal_task_submitter pipelining); an EXPLICIT queue (not
+        # the executor's opaque one) lets the block hook return
+        # unstarted tasks when the running task parks in get() — the
+        # no-deadlock guarantee behind depth > 1.
+        if self._exec_blocked and (self._task_running
+                                   or self._task_queue):
+            return TaskResult(task_id=spec.task_id, ok=False,
+                              requeue=True)
         loop = asyncio.get_event_loop()
-        return await loop.run_in_executor(
-            self._task_executor, self._execute_sync, spec, fn,
-            p.get("lease_id"), p.get("chip_ids") or [])
+        fut: asyncio.Future = loop.create_future()
+        self._task_queue.append((spec, p, fut))
+        self._ensure_task_runner()
+        return await fut
+
+    def _ensure_task_runner(self) -> None:
+        """(Re)start the drain task; a done-callback respawns it if a
+        push raced the drain thread's final empty-check (that window
+        spans a thread->loop handoff, so it is very real)."""
+        if self._task_runner is None or self._task_runner.done():
+            self._task_runner = spawn_task(self._task_runner_loop())
+            self._task_runner.add_done_callback(
+                lambda _t: (self._task_queue
+                            and self._ensure_task_runner()))
+
+    async def _task_runner_loop(self) -> None:
+        """Drain the task queue in ONE executor submission: the thread
+        body pops and executes tasks back-to-back (no per-task
+        executor handoff), posting each result to the loop.  The
+        block hook runs ON this same thread, so its requeue drain
+        cannot race the popper."""
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(self._task_executor,
+                                   self._drain_queue_in_thread, loop)
+
+    def _drain_queue_in_thread(self, loop) -> None:
+        while True:
+            try:
+                spec, p, fut = self._task_queue.popleft()
+            except IndexError:
+                break
+            if fut is not None and fut.done():
+                continue
+            self._task_running = True
+            try:
+                fn = self._load_func(spec)
+                res = self._execute_sync(
+                    spec, fn, p.get("lease_id"),
+                    p.get("chip_ids") or [])
+            except BaseException as e:  # noqa: BLE001
+                res = TaskResult(task_id=spec.task_id, ok=False,
+                                 error=TaskError.from_exception(e))
+            finally:
+                self._task_running = False
+            if fut is not None:
+                loop.call_soon_threadsafe(
+                    lambda f=fut, r=res:
+                    f.set_result(r) if not f.done() else None)
+            else:
+                loop.call_soon_threadsafe(
+                    self._queue_result, p, res)
+        loop.call_soon_threadsafe(self._flush_results)
+
+    # ---- batched exec channel (owner notifies exec_batch; results
+    # ---- return as task_results notifies; ref: the push/report split
+    # ---- in core_worker.proto, batched for frame/syscall amortization)
+    async def exec_batch(self, p):
+        if self._exec_blocked and (self._task_running
+                                   or self._task_queue):
+            for item in p["tasks"]:
+                self._queue_result(
+                    {"caller_tag": p["caller_tag"],
+                     "reply_id": item["reply_id"]},
+                    TaskResult(task_id=item["spec"].task_id, ok=False,
+                               requeue=True))
+            self._flush_results()
+            return
+        env_err = os.environ.get("RT_RUNTIME_ENV_ERROR")
+        for item in p["tasks"]:
+            spec = item["spec"]
+            ctx = {"caller_tag": p["caller_tag"],
+                   "reply_id": item["reply_id"],
+                   "lease_id": p.get("lease_id"),
+                   "chip_ids": p.get("chip_ids") or []}
+            if env_err:
+                from .errors import RuntimeEnvSetupError
+
+                self._queue_result(ctx, TaskResult(
+                    task_id=spec.task_id, ok=False,
+                    error=TaskError.from_exception(
+                        RuntimeEnvSetupError(env_err))),
+                    flush_now=True)
+                continue
+            if spec.is_streaming:
+                self._stream_callers[spec.task_id.hex()] = \
+                    p["caller_tag"]
+            self._task_queue.append((spec, ctx, None))
+        self._ensure_task_runner()
+
+    def _queue_result(self, ctx, res: TaskResult,
+                      flush_now: bool = False) -> None:
+        self._result_buf.setdefault(ctx["caller_tag"], []).append(
+            (ctx["reply_id"], res))
+        if flush_now or sum(len(v) for v in
+                            self._result_buf.values()) >= 8:
+            self._flush_results()
+        elif not self._flush_scheduled:
+            # Flush after the current loop burst: results completing
+            # together batch into one frame, nothing waits on a timer.
+            self._flush_scheduled = True
+            self._loop.call_soon(self._scheduled_flush)
+
+    def _scheduled_flush(self) -> None:
+        self._flush_scheduled = False
+        self._flush_results()
+
+    def _flush_results(self) -> None:
+        buf, self._result_buf = self._result_buf, {}
+        for tag, entries in buf.items():
+            self.server.notify_peer(tag, "task_results",
+                                    {"results": entries})
+
+    def _on_exec_block(self, blocked: bool) -> None:
+        """Runs on the TASK THREAD when the current task blocks in
+        get(): marshal a queue drain to the loop so queued-behind
+        tasks fail over instead of waiting out the block."""
+        self._exec_blocked = blocked
+        if blocked and self._loop is not None:
+            self._loop.call_soon_threadsafe(self._requeue_queued)
+
+    def _requeue_queued(self) -> None:
+        if not self._exec_blocked:
+            # The blocking get resolved before this callback ran — a
+            # spurious drain would bounce the whole pipeline back to
+            # the owner for nothing.
+            return
+        while self._task_queue:
+            spec, ctx, fut = self._task_queue.popleft()
+            res = TaskResult(task_id=spec.task_id, ok=False,
+                             requeue=True)
+            if fut is not None:
+                if not fut.done():
+                    fut.set_result(res)
+            else:
+                self._queue_result(ctx, res)
+        self._flush_results()
 
     async def stream_ack(self, p):
         """Owner consumed stream items up to ``consumed`` — release
@@ -530,6 +683,18 @@ class Worker:
             if mopts and mopts.get("concurrency_group"):
                 self._method_groups[mname] = mopts["concurrency_group"]
         self._group_sems[""] = asyncio.Semaphore(n)
+        # All-sync ordered actors take a queue+drain-thread fast path
+        # in exec_actor (no per-call executor handoff); any coroutine
+        # method forces the lock path so sync/async arrival order is
+        # preserved.
+        self._actor_all_sync = not any(
+            inspect.iscoroutinefunction(getattr(instance, m, None))
+            or inspect.isgeneratorfunction(getattr(instance, m, None))
+            for m in spec.method_names)
+        from collections import deque as _dq
+
+        self._actor_call_queue: "_dq" = _dq()
+        self._actor_drain: Optional[asyncio.Task] = None
         # max_concurrency=1: owners PIPELINE calls (frames arrive before
         # earlier replies are sent), so ordering must be enforced here —
         # one FIFO lock serializing sync and async methods in arrival
@@ -639,6 +804,72 @@ class Worker:
                              **trace_extra)
             return TaskResult(task_id=spec.task_id, ok=False,
                               error=ActorError.from_exception(e))
+
+    async def exec_actor(self, p):
+        """Notify-based actor call: like push_actor_task but the
+        result returns through the batched task_results channel (one
+        response frame per burst instead of per call)."""
+        spec: TaskSpec = p["spec"]
+        ctx = {"caller_tag": p["caller_tag"],
+               "reply_id": p["reply_id"]}
+        if self.actor_instance is None:
+            self._queue_result(ctx, TaskResult(
+                task_id=spec.task_id, ok=False,
+                error=ActorError.from_exception(RuntimeError(
+                    "actor not initialized on this worker"))))
+            return
+        method = getattr(self.actor_instance, spec.method_name, None)
+        if method is None:
+            self._queue_result(ctx, TaskResult(
+                task_id=spec.task_id, ok=False,
+                error=ActorError.from_exception(AttributeError(
+                    f"actor has no method {spec.method_name!r}"))))
+            return
+        if spec.is_streaming:
+            self._stream_callers[spec.task_id.hex()] = \
+                p.get("caller_tag", "")
+        lock = getattr(self, "_actor_exec_lock", None)
+        if lock is not None and self._actor_all_sync:
+            # No generator/coroutine methods exist on this actor (the
+            # _actor_all_sync predicate excludes them), so every call
+            # takes THIS path — the lock path below can never
+            # interleave out of arrival order with the queue.
+            # Ordered all-sync actor: drain calls back-to-back on the
+            # actor thread (arrival order == queue order == execution
+            # order; one executor submission per burst).
+            self._actor_call_queue.append((spec, method, ctx))
+            self._ensure_actor_drain()
+            return
+        if lock is not None:
+            async with lock:
+                res = await self._run_actor_method(spec, method)
+        else:
+            res = await self._run_actor_method(spec, method)
+        self._queue_result(ctx, res)
+
+    def _ensure_actor_drain(self) -> None:
+        if self._actor_drain is None or self._actor_drain.done():
+            self._actor_drain = spawn_task(self._actor_drain_loop())
+            self._actor_drain.add_done_callback(
+                lambda _t: (self._actor_call_queue
+                            and self._ensure_actor_drain()))
+
+    async def _actor_drain_loop(self) -> None:
+        loop = asyncio.get_event_loop()
+        lock = self._actor_exec_lock
+        async with lock:   # serialize vs push_actor_task arrivals
+            await loop.run_in_executor(
+                self.actor_executor, self._drain_actor_calls, loop)
+
+    def _drain_actor_calls(self, loop) -> None:
+        while True:
+            try:
+                spec, method, ctx = self._actor_call_queue.popleft()
+            except IndexError:
+                break
+            res = self._execute_sync(spec, method, None, [])
+            loop.call_soon_threadsafe(self._queue_result, ctx, res)
+        loop.call_soon_threadsafe(self._flush_results)
 
     async def cancel_task(self, p):
         """Best-effort in-band cancellation (ref: core_worker CancelTask →
