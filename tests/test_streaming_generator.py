@@ -166,7 +166,15 @@ def test_streaming_actor_method():
 
         c = Chunker.remote()
         gen = c.chunks.options(num_returns="streaming").remote(4)
-        items = [ray_tpu.get(r, timeout=60) for r in gen]
+        # Bounded iteration: a lost final reply must FAIL the test,
+        # not hang the whole suite (observed once as a load flake).
+        items = []
+        while True:
+            try:
+                ref = gen._next_ref(timeout=120)
+            except StopIteration:
+                break
+            items.append(ray_tpu.get(ref, timeout=60))
         assert items == [{"chunk": i} for i in range(4)]
     finally:
         ray_tpu.shutdown()
