@@ -745,7 +745,7 @@ class ClusterRuntime(BaseRuntime):
         if spec.is_streaming:
             from .object_ref import ObjectRefGenerator
 
-            return [ObjectRefGenerator(spec.task_id, oids[0])]
+            return [ObjectRefGenerator(spec.task_id, oids[0], self)]
         return [ObjectRef(o) for o in oids]
 
     def _drain_submit_buf(self) -> None:
@@ -1684,7 +1684,7 @@ class ClusterRuntime(BaseRuntime):
         if spec.is_streaming:
             from .object_ref import ObjectRefGenerator
 
-            return [ObjectRefGenerator(spec.task_id, oids[0])]
+            return [ObjectRefGenerator(spec.task_id, oids[0], self)]
         return [ObjectRef(o) for o in oids]
 
     async def _actor_info(self, actor_id: ActorID,

@@ -146,7 +146,7 @@ class LocalRuntime(BaseRuntime):
         st.done = True
         self._streams[spec.task_id.hex()] = st
         return [ObjectRefGenerator(spec.task_id,
-                                   spec.return_object_ids()[0])]
+                                   spec.return_object_ids()[0], self)]
 
     def stream_ack(self, task_id, consumed, worker_addr) -> None:
         pass  # eager local streams have no executor to un-block

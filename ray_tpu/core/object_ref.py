@@ -46,7 +46,7 @@ def collect_embedded_refs():
 
 
 class ObjectRef:
-    __slots__ = ("id", "_owner", "_in_band", "_counted")
+    __slots__ = ("id", "_owner", "_in_band", "_counted", "_gen")
 
     def __init__(self, object_id: ObjectID, owner: str = "",
                  in_band: bool = False, counted: bool = True):
@@ -55,9 +55,15 @@ class ObjectRef:
         self._in_band = in_band  # True when created by local-mode put
         self._counted = counted  # False for internal transient handles
         if not counted:
+            self._gen = -1
             return
         from . import runtime
 
+        # Runtime GENERATION stamp: id counters reset across
+        # shutdown()/init() in one process, so a stale ref GC'd after
+        # a re-init must not decrement a COLLIDING id's refcount on
+        # the new runtime.
+        self._gen = runtime.current_generation()
         rt = runtime.get_runtime_quiet()
         if rt is not None:
             rt.add_local_ref(object_id)
@@ -71,6 +77,8 @@ class ObjectRef:
                 return
             from . import runtime
 
+            if runtime.current_generation() != self._gen:
+                return  # born under a previous runtime generation
             rt = runtime.get_runtime_quiet()
             if rt is not None:
                 rt.remove_local_ref(self.id)
@@ -132,12 +140,24 @@ class ObjectRefGenerator:
     blocking wait to the default executor.
     """
 
-    def __init__(self, task_id, sentinel_id: ObjectID):
+    def __init__(self, task_id, sentinel_id: ObjectID,
+                 owner_runtime=None):
         self.task_id = task_id
         # Submission bookkeeping (cancel, pending) anchors on the
         # sentinel id; expose it as .id so ray_tpu.cancel(gen) works.
         self.id = sentinel_id
         self._closed = False
+        # Bind to the OWNING runtime (weakly): task-id counters reset
+        # across shutdown()/init() generations inside one process, so
+        # a stale generator used after a re-init must not touch a
+        # COLLIDING id's live stream on the new runtime (observed as
+        # a vanishing actor stream whenever test ordering realigned
+        # the counters).  The owner is passed explicitly by the
+        # submitting runtime.
+        import weakref
+
+        self._rt_ref = (weakref.ref(owner_runtime)
+                        if owner_runtime is not None else None)
 
     # ------------------------------------------------------ sync iterator
     def __iter__(self):
@@ -152,7 +172,11 @@ class ObjectRefGenerator:
         from . import runtime as _runtime
         from .errors import GetTimeoutError
 
-        rt = _runtime.get_runtime()
+        rt = self._rt_ref() if self._rt_ref is not None else None
+        if rt is None or rt is not _runtime.get_runtime_quiet():
+            # Owning runtime gone or superseded: the stream died with
+            # it; never touch a colliding id's state on a newer one.
+            raise StopIteration
         st = rt._streams.get(self.task_id.hex())
         if st is None:
             raise StopIteration
@@ -236,7 +260,8 @@ class ObjectRefGenerator:
         from . import runtime as _runtime
 
         rt = _runtime.get_runtime_quiet()
-        if rt is not None:
+        owner = self._rt_ref() if self._rt_ref is not None else None
+        if rt is not None and rt is owner:
             try:
                 rt._stream_close(self.task_id)
             except Exception:

@@ -22,6 +22,16 @@ from .task import TaskSpec
 
 _global_lock = threading.Lock()
 _global_runtime: Optional["BaseRuntime"] = None
+# Monotonic runtime GENERATION: bumps on every set_runtime.  Id
+# counters (task/put) reset across shutdown()/init() inside one
+# process, so ids COLLIDE across generations — lifecycle hooks of
+# refs born under an older generation must become no-ops instead of
+# mutating a colliding id's state on the new runtime.
+_generation = 0
+
+
+def current_generation() -> int:
+    return _generation
 
 
 def get_runtime() -> "BaseRuntime":
@@ -45,9 +55,10 @@ def is_initialized() -> bool:
 
 
 def set_runtime(rt: Optional["BaseRuntime"]) -> None:
-    global _global_runtime
+    global _global_runtime, _generation
     with _global_lock:
         _global_runtime = rt
+        _generation += 1
 
 
 class _TaskContext(threading.local):
