@@ -18,13 +18,8 @@ import time
 import jax
 import jax.numpy as jnp
 
-# bf16 peak FLOPs/s per chip by TPU generation (public spec sheets).
-_PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-}
+from ray_tpu.train.config import PEAK_FLOPS_BY_GEN as _PEAK_FLOPS
+from ray_tpu.util import goodput as _goodput
 
 
 def main() -> None:
@@ -78,16 +73,19 @@ def main() -> None:
         return state, losses[-1]
 
     runner = jax.jit(run, static_argnums=(2,))
+    ledger = _goodput.reset()
     # Warm up with the SAME step count (static arg => per-n executable;
     # timing a fresh n would measure compilation, not training).
-    _, loss = runner(state, tokens, steps)
-    _ = jax.device_get(loss)
+    with ledger.phase("compile"):
+        _, loss = runner(state, tokens, steps)
+        _ = jax.device_get(loss)
 
     best = 0.0
     for _ in range(reps):
         t0 = time.perf_counter()
-        _, loss = runner(state, tokens, steps)
-        _ = jax.device_get(loss)
+        with ledger.phase("compute"):
+            _, loss = runner(state, tokens, steps)
+            _ = jax.device_get(loss)
         elapsed = time.perf_counter() - t0
         best = max(best, batch * cfg.max_seq * steps / elapsed)
 
@@ -96,12 +94,25 @@ def main() -> None:
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     peak = _PEAK_FLOPS.get(gen, _PEAK_FLOPS["v5e"])
     mfu = tok_s * flops_per_token / peak if on_tpu else 0.0
+    # Telemetry-plane smoke check: a bench run must emit a non-empty
+    # goodput summary whose fractions sum to ~1.0, so the goodput
+    # ledger can't silently rot (it has no other standalone exercise).
+    # Explicit raise, not assert — must survive `python -O`.
+    gp = ledger.snapshot()
+    fracs = ledger.fractions()
+    if gp["seconds"].get("compute", 0.0) <= 0.0 \
+            or gp["seconds"].get("compile", 0.0) <= 0.0:
+        raise RuntimeError(
+            f"empty goodput summary from bench run: {gp}")
+    if abs(sum(fracs.values()) - 1.0) >= 1e-6:
+        raise RuntimeError(f"goodput fractions don't normalize: {fracs}")
     out = {
         "metric": "gpt2_124m_pretrain_tokens_per_sec_per_chip"
         if on_tpu else "gpt2_scaled_cpu_tokens_per_sec",
         "value": round(tok_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
+        "goodput": {p: round(f, 4) for p, f in fracs.items()},
     }
     print(json.dumps(out))
     _maybe_record(out)

@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from .. import telemetry as _telemetry
 from ..types import ReduceOp
 
 _LEN = struct.Struct("<Q")
@@ -170,8 +171,10 @@ class CPUGroup:
             time.sleep(0.01)
 
     # ------------------------------------------------------------ ops (hub)
-    def allreduce(self, array, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
-        array = np.asarray(array)
+    def _allreduce(self, array: np.ndarray, op: ReduceOp) -> np.ndarray:
+        """Untimed core — barrier/reducescatter compose on this so the
+        composite op records ONE telemetry sample, not a nested bogus
+        allreduce one."""
         if self.world_size == 1:
             return _reduce([array], op)
         if self.rank == 0:
@@ -185,44 +188,58 @@ class CPUGroup:
         _send_msg(self._hub, array)
         return _recv_msg(self._hub)
 
+    def allreduce(self, array, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        array = np.asarray(array)
+        with _telemetry.timed_op("allreduce", "cpu", self.world_size,
+                                 array.nbytes):
+            return self._allreduce(array, op)
+
     def allgather(self, array) -> List[np.ndarray]:
         array = np.asarray(array)
-        if self.world_size == 1:
-            return [array]
-        if self.rank == 0:
-            parts = [array] + [None] * (self.world_size - 1)
-            for r in range(1, self.world_size):
-                parts[r] = _recv_msg(self._peers[r])
-            for r in range(1, self.world_size):
-                _send_msg(self._peers[r], parts)
-            return parts
-        _send_msg(self._hub, array)
-        return _recv_msg(self._hub)
+        with _telemetry.timed_op("allgather", "cpu", self.world_size,
+                                 array.nbytes):
+            if self.world_size == 1:
+                return [array]
+            if self.rank == 0:
+                parts = [array] + [None] * (self.world_size - 1)
+                for r in range(1, self.world_size):
+                    parts[r] = _recv_msg(self._peers[r])
+                for r in range(1, self.world_size):
+                    _send_msg(self._peers[r], parts)
+                return parts
+            _send_msg(self._hub, array)
+            return _recv_msg(self._hub)
 
     def reducescatter(self, array, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         """Reduce then return this rank's 1/world_size shard (axis 0)."""
         array = np.asarray(array)
-        total = self.allreduce(array, op)
-        shards = np.array_split(total, self.world_size, axis=0)
-        return shards[self.rank]
+        with _telemetry.timed_op("reducescatter", "cpu",
+                                 self.world_size, array.nbytes):
+            total = self._allreduce(array, op)
+            shards = np.array_split(total, self.world_size, axis=0)
+            return shards[self.rank]
 
     def broadcast(self, array, src_rank: int = 0) -> np.ndarray:
-        if self.world_size == 1:
-            return np.asarray(array)
-        if self.rank == 0:
-            if src_rank == 0:
-                data = np.asarray(array)
-            else:
-                data = _recv_msg(self._peers[src_rank])
-            for r in range(1, self.world_size):
-                _send_msg(self._peers[r], data)
-            return data
-        if self.rank == src_rank:
-            _send_msg(self._hub, np.asarray(array))
-        return _recv_msg(self._hub)
+        arr = np.asarray(array)
+        with _telemetry.timed_op("broadcast", "cpu", self.world_size,
+                                 arr.nbytes):
+            if self.world_size == 1:
+                return arr
+            if self.rank == 0:
+                if src_rank == 0:
+                    data = arr
+                else:
+                    data = _recv_msg(self._peers[src_rank])
+                for r in range(1, self.world_size):
+                    _send_msg(self._peers[r], data)
+                return data
+            if self.rank == src_rank:
+                _send_msg(self._hub, arr)
+            return _recv_msg(self._hub)
 
     def barrier(self) -> None:
-        self.allreduce(np.zeros(1, dtype=np.int8))
+        with _telemetry.timed_op("barrier", "cpu", self.world_size):
+            self._allreduce(np.zeros(1, dtype=np.int8), ReduceOp.SUM)
 
     # ------------------------------------------------------------- ops (p2p)
     def send(self, array, dst_rank: int) -> None:
@@ -232,10 +249,14 @@ class CPUGroup:
         conn = self._p2p_out.get(dst_rank)
         if conn is None:
             conn = self._p2p_out[dst_rank] = self._dial(dst_rank, "p2p")
-        _send_msg(conn, np.asarray(array))
+        arr = np.asarray(array)
+        with _telemetry.timed_op("send", "cpu", self.world_size,
+                                 arr.nbytes):
+            _send_msg(conn, arr)
 
     def recv(self, src_rank: int, timeout: float = 120.0) -> np.ndarray:
-        return self._p2p_queue(src_rank).get(timeout=timeout)
+        with _telemetry.timed_op("recv", "cpu", self.world_size):
+            return self._p2p_queue(src_rank).get(timeout=timeout)
 
     def destroy(self) -> None:
         self._closed = True

@@ -25,6 +25,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from .. import telemetry as _telemetry
 from ..types import ReduceOp
 
 _initialized_world = None  # (world_size, rank) after jax.distributed init
@@ -99,8 +100,10 @@ class XLAGroup:
         stacked = multihost_utils.process_allgather(np.asarray(array))
         return [np.asarray(s) for s in stacked]
 
-    def allreduce(self, array, op: ReduceOp = ReduceOp.SUM):
-        parts = self._gather_all(array)
+    def _allreduce(self, arr: np.ndarray, op: ReduceOp) -> np.ndarray:
+        """Untimed core — reducescatter composes on this so the
+        composite op records ONE telemetry sample."""
+        parts = self._gather_all(arr)
         out = np.array(parts[0], copy=True)
         for p in parts[1:]:
             if op in (ReduceOp.SUM, ReduceOp.MEAN):
@@ -115,24 +118,41 @@ class XLAGroup:
             out = out / len(parts)
         return out
 
+    def allreduce(self, array, op: ReduceOp = ReduceOp.SUM):
+        arr = np.asarray(array)
+        with _telemetry.timed_op("allreduce", "xla", self.world_size,
+                                 arr.nbytes):
+            return self._allreduce(arr, op)
+
     def allgather(self, array) -> List[np.ndarray]:
-        return self._gather_all(array)
+        arr = np.asarray(array)
+        with _telemetry.timed_op("allgather", "xla", self.world_size,
+                                 arr.nbytes):
+            return self._gather_all(arr)
 
     def reducescatter(self, array, op: ReduceOp = ReduceOp.SUM):
-        total = self.allreduce(array, op)
-        return np.array_split(total, self.world_size, axis=0)[self.rank]
+        arr = np.asarray(array)
+        with _telemetry.timed_op("reducescatter", "xla",
+                                 self.world_size, arr.nbytes):
+            total = self._allreduce(arr, op)
+            return np.array_split(total, self.world_size,
+                                  axis=0)[self.rank]
 
     def broadcast(self, array, src_rank: int = 0):
         from jax.experimental import multihost_utils
 
-        return np.asarray(multihost_utils.broadcast_one_to_all(
-            np.asarray(array), is_source=self.rank == src_rank))
+        arr = np.asarray(array)
+        with _telemetry.timed_op("broadcast", "xla", self.world_size,
+                                 arr.nbytes):
+            return np.asarray(multihost_utils.broadcast_one_to_all(
+                arr, is_source=self.rank == src_rank))
 
     def barrier(self) -> None:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(
-            f"rt_barrier_{self.group_name}")
+        with _telemetry.timed_op("barrier", "xla", self.world_size):
+            multihost_utils.sync_global_devices(
+                f"rt_barrier_{self.group_name}")
 
     def send(self, array, dst_rank: int) -> None:
         raise NotImplementedError(

@@ -1,0 +1,90 @@
+"""Collective-op telemetry shared by the CPU and XLA backends.
+
+Every eager collective records (op, backend, group size, payload bytes,
+latency) into the process-local metrics registry:
+
+  rt_collective_latency_seconds{op,backend,world}   latency histogram
+  rt_collective_bus_bandwidth_bytes_per_sec{op,backend}
+                                                    effective bus BW
+
+Bus bandwidth uses the standard nccl-tests algbw→busbw factors so
+numbers are comparable across ops and group sizes (allreduce moves
+2(n-1)/n of the payload per link, allgather/reducescatter (n-1)/n,
+broadcast/p2p 1).  Snapshots ride the existing worker heartbeat; the
+op is also appended to the flight recorder ring so a postmortem shows
+which collective a dead worker was in.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+# Latency boundaries tuned for collectives: 100µs .. 30s.
+_BOUNDS = (1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+           30.0)
+
+_BUSBW_FACTOR = {
+    "allreduce": lambda n: 2.0 * (n - 1) / n,
+    "reducescatter": lambda n: (n - 1) / n,
+    "allgather": lambda n: (n - 1) / n,
+    "broadcast": lambda n: 1.0,
+    "barrier": lambda n: 0.0,
+    "send": lambda n: 1.0,
+    "recv": lambda n: 1.0,
+}
+
+
+def record_op(op: str, backend: str, world_size: int, nbytes: int,
+              seconds: float) -> None:
+    try:
+        from ..util import flight_recorder
+        from ..util.metrics import Gauge, Histogram
+
+        tags = {"op": op, "backend": backend, "world": str(world_size)}
+        Histogram("rt_collective_latency_seconds",
+                  "Eager collective op latency.",
+                  boundaries=_BOUNDS,
+                  tag_keys=("op", "backend", "world")).observe(
+            seconds, tags=tags)
+        factor = _BUSBW_FACTOR.get(op, lambda n: 1.0)(
+            max(world_size, 1))
+        if nbytes > 0 and seconds > 0 and factor > 0:
+            # Same tag set as the histogram: groups of different sizes
+            # must not overwrite one another's series.
+            Gauge("rt_collective_bus_bandwidth_bytes_per_sec",
+                  "Effective bus bandwidth of the last collective "
+                  "(nccl-tests busbw convention).",
+                  tag_keys=("op", "backend", "world")).set(
+                nbytes * factor / seconds, tags=tags)
+        flight_recorder.record("collective", op=op, backend=backend,
+                               world=world_size, bytes=nbytes,
+                               seconds=round(seconds, 6))
+    except Exception:
+        pass  # telemetry must never fail a collective
+
+
+@contextmanager
+def timed_op(op: str, backend: str, world_size: int, nbytes: int = 0):
+    # Flight-record the START too: a worker preempted mid-collective
+    # must show WHICH op it was blocked in — completion-only records
+    # would miss exactly the hung/preempted case postmortems exist for.
+    try:
+        from ..util import flight_recorder
+
+        flight_recorder.record("collective_begin", op=op,
+                               backend=backend, world=world_size,
+                               bytes=nbytes)
+    except Exception:
+        flight_recorder = None
+    t0 = time.perf_counter()
+    try:
+        yield
+    except BaseException as e:
+        if flight_recorder is not None:
+            flight_recorder.record(
+                "collective_error", op=op, error=repr(e),
+                seconds=round(time.perf_counter() - t0, 6))
+        raise
+    record_op(op, backend, world_size, nbytes,
+              time.perf_counter() - t0)

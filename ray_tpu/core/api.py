@@ -33,7 +33,10 @@ _DEFAULT_OPTIONS = dict(
     name="",
     max_restarts=0,
     max_task_retries=0,
-    max_concurrency=1,
+    # None = unset: sync actors resolve to 1, async actors to 1000
+    # (the reference's DEFAULT_MAX_CONCURRENCY_ASYNC).  An EXPLICIT
+    # max_concurrency=1 on an async actor is honored, not bumped.
+    max_concurrency=None,
     concurrency_groups=None,
     lifetime=None,
     namespace="",
@@ -391,13 +394,22 @@ class ActorClass:
         has_async = any(
             inspect.iscoroutinefunction(getattr(self._cls, n, None))
             for n in method_names)
-        if has_async and max_concurrency == 1:
-            # Async actors interleave natively; default their window
-            # like the reference (ref: DEFAULT_MAX_CONCURRENCY_ASYNC
-            # = 1000 for asyncio actors) — including grouped actors,
-            # whose DEFAULT group would otherwise serialize await-
-            # holding methods into a deadlock.
-            max_concurrency = 1000
+        if max_concurrency is None:
+            # Unset: async actors interleave natively; default their
+            # window like the reference (ref:
+            # DEFAULT_MAX_CONCURRENCY_ASYNC = 1000 for asyncio actors)
+            # — including grouped actors, whose DEFAULT group would
+            # otherwise serialize await-holding methods into a
+            # deadlock.  An explicit max_concurrency=1 is honored:
+            # code relying on serialized async actors must not get
+            # surprise interleaving.  (Corollary: an EXPLICIT 1 on an
+            # async actor whose default-group methods await each other
+            # can deadlock — that's now the caller's stated choice,
+            # same as the reference.)
+            max_concurrency = 1000 if has_async else 1
+        elif max_concurrency < 1:
+            raise ValueError(
+                f"max_concurrency must be >= 1, got {max_concurrency}")
         spec = TaskSpec(
             task_id=rt.actor_creation_task_id(actor_id),
             job_id=rt.job_id,

@@ -98,6 +98,9 @@ class Controller:
         # Cluster metrics: latest snapshot per reporting source (ref:
         # metrics agent / opencensus exporter, metric_defs.cc).
         self.metrics_sources: Dict[str, Any] = {}
+        # Flight-recorder dumps forwarded by node agents when a worker
+        # dies (bounded; newest wins per source).
+        self.flight_dumps: "OrderedDict[str, Dict]" = OrderedDict()
         self._agent_clients: Dict[NodeID, RpcClient] = {}
         self._placement = None  # PlacementGroupManager, attached in setup
         self._shutdown = asyncio.Event()
@@ -117,6 +120,7 @@ class Controller:
             "task_events", "list_tasks", "get_task", "list_objects",
             "list_jobs", "report_metrics", "metrics_text",
             "metrics_history", "get_load_metrics", "worker_logs",
+            "telemetry", "report_flight_dump",
         ]:
             self.server.register(name, getattr(self, name))
 
@@ -762,12 +766,53 @@ class Controller:
                     key += "{" + ",".join(
                         f"{k}={v}" for k, v in sorted(tags.items())) \
                         + "}"
-                flat[key] = float(s["value"])
+                if "value" in s:
+                    flat[key] = float(s["value"])
+                elif "hist" in s:
+                    # Histogram series flatten to their running count
+                    # and sum — enough for rate/mean time series.
+                    flat[key + "_count"] = float(s["hist"]["count"])
+                    flat[key + "_sum"] = float(s["hist"]["sum"])
         dq = hist.get(p["source"])
         if dq is None:
             dq = hist[p["source"]] = deque(maxlen=360)
         dq.append((now, flat))
         return {"ok": True}
+
+    async def report_flight_dump(self, p):
+        """A node agent forwards a dead worker's flight-recorder dump
+        (ref: the reference's dashboard event aggregation; here the
+        postmortem ring of a reaped process)."""
+        src = p.get("source") or "?"
+        self.flight_dumps[src] = {
+            "source": src, "reason": p.get("reason", ""),
+            "ts": p.get("ts"), "path": p.get("path", ""),
+            "sticky": p.get("sticky") or {},
+            "events": (p.get("events") or [])[-200:]}
+        self.flight_dumps.move_to_end(src)
+        while len(self.flight_dumps) > 32:
+            self.flight_dumps.popitem(last=False)
+        return {"ok": True}
+
+    def _prune_metrics_sources(self, now: float) -> None:
+        """Drop sources that stopped reporting (dead workers/nodes) —
+        a gauge from a dead process must not render as current, and
+        the map must not grow with worker churn."""
+        horizon = max(self.config.metrics_report_period_s * 6, 30.0)
+        for src in [s for s, v in self.metrics_sources.items()
+                    if now - v["ts"] > horizon]:
+            del self.metrics_sources[src]
+
+    async def telemetry(self, p):
+        """Raw telemetry feed for `rt telemetry` / /api/telemetry:
+        latest per-source metric snapshots + retained flight dumps.
+        Aggregation happens client-side (util/telemetry.py)."""
+        now = time.time()
+        self._prune_metrics_sources(now)
+        return {"ts": now,
+                "sources": {s: v["snapshot"]
+                            for s, v in self.metrics_sources.items()},
+                "flight": list(self.flight_dumps.values())}
 
     def _prune_metrics_history(self, now: float) -> None:
         """Dead sources must not leak deques under worker churn (the
@@ -796,14 +841,8 @@ class Controller:
     async def metrics_text(self, _p):
         from ray_tpu.util.metrics import render_prometheus
 
-        # Drop sources that stopped reporting (dead workers/nodes) — a
-        # gauge from a dead process must not render as current, and the
-        # map must not grow with worker churn.
-        horizon = max(self.config.metrics_report_period_s * 6, 30.0)
         now = time.time()
-        for src in [s for s, v in self.metrics_sources.items()
-                    if now - v["ts"] > horizon]:
-            del self.metrics_sources[src]
+        self._prune_metrics_sources(now)
         self._prune_metrics_history(now)
         sources = {s: v["snapshot"]
                    for s, v in self.metrics_sources.items()}

@@ -420,7 +420,29 @@ class NodeAgent:
                     "reason": f"worker exited with code {code}"})
             except RpcError:
                 pass
+        await self._forward_flight_dump(w)
         logger.info("worker %s exited (state=%s)", w.pid, prev_state)
+
+    async def _forward_flight_dump(self, w: WorkerEntry) -> None:
+        """If the dead worker left a flight-recorder dump, ship it to
+        the controller so postmortems work cluster-wide (the file stays
+        on disk for offline triage)."""
+        path = os.path.join(
+            self.config.session_dir_root, self.session, "flight",
+            f"worker-{self.node_id.hex()[:8]}-{w.pid}.json")
+        try:
+            if not os.path.exists(path):
+                return
+            with open(path) as f:
+                data = json.load(f)
+            await self._ctl.call("report_flight_dump", {
+                "source": data.get("source") or f"worker-{w.pid}",
+                "reason": data.get("reason", ""),
+                "ts": data.get("ts"), "path": path,
+                "sticky": data.get("sticky") or {},
+                "events": (data.get("events") or [])[-200:]})
+        except (OSError, ValueError, RpcError):
+            pass
 
     # --------------------------------------------------------- worker pool
     def _spawn_worker(self, runtime_env: Optional[Dict] = None) -> None:

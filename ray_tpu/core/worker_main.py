@@ -130,6 +130,20 @@ class Worker:
         ev.update(extra)
         with self._event_lock:
             self._event_buf.append(ev)
+        # Mirror into the crash flight recorder so a preempted
+        # worker's dump shows what it was executing: routine
+        # transitions overwrite ONE sticky slot (flooding the ring at
+        # batch-task rates would evict the train/collective context
+        # the dump exists for); failures append as real ring events.
+        from ray_tpu.util import flight_recorder
+
+        if state == "FAILED":
+            flight_recorder.record("task_failed", name=ev["name"],
+                                   task_id=ev["task_id"],
+                                   error=extra.get("error"))
+        else:
+            flight_recorder.note("last_task", name=ev["name"],
+                                 state=state, task_id=ev["task_id"])
 
     async def _flush_loop(self) -> None:
         """Ship task events + metric snapshots on one cadence."""
@@ -739,6 +753,17 @@ class Worker:
             self._stream_callers[spec.task_id.hex()] = \
                 p.get("caller_tag", "")
         lock = getattr(self, "_actor_exec_lock", None)
+        if lock is not None and getattr(self, "_actor_all_sync", False):
+            # All-sync ordered actor: route through the SAME queue as
+            # exec_batch arrivals.  Taking the lock directly here could
+            # win it before an earlier exec_actor's drain task starts,
+            # executing this later call first — mixed submission paths
+            # must not violate arrival-order execution.
+            loop = asyncio.get_event_loop()
+            fut: asyncio.Future = loop.create_future()
+            self._actor_call_queue.append((spec, method, fut))
+            self._ensure_actor_drain()
+            return await fut
         if lock is not None:
             async with lock:
                 return await self._run_actor_method(spec, method)
@@ -868,7 +893,12 @@ class Worker:
             except IndexError:
                 break
             res = self._execute_sync(spec, method, None, [])
-            loop.call_soon_threadsafe(self._queue_result, ctx, res)
+            if isinstance(ctx, dict):  # exec_actor notify path
+                loop.call_soon_threadsafe(self._queue_result, ctx, res)
+            else:  # push_actor_task future
+                loop.call_soon_threadsafe(
+                    lambda f=ctx, r=res:
+                    f.set_result(r) if not f.done() else None)
         loop.call_soon_threadsafe(self._flush_results)
 
     async def cancel_task(self, p):
@@ -937,6 +967,21 @@ def main() -> None:
     # to the worker log (the reference exposes py-spy via the dashboard;
     # this is the dependency-free equivalent for hung-worker triage).
     faulthandler.register(_signal.SIGUSR1, all_threads=True)
+    # Crash flight recorder: dump the telemetry ring on SIGTERM or an
+    # uncaught exception so postmortems on preempted slices are
+    # possible.  Must install from the main thread (signal handler).
+    try:
+        from ray_tpu.util import flight_recorder
+
+        cfg = RuntimeConfig.from_env()
+        flight_recorder.install(
+            dump_dir=os.path.join(cfg.session_dir_root,
+                                  os.environ["RT_SESSION_NAME"],
+                                  "flight"),
+            source=f"worker-{os.environ['RT_NODE_ID'][:8]}"
+                   f"-{os.getpid()}")
+    except Exception:
+        logging.debug("flight recorder install failed", exc_info=True)
 
     async def _run():
         w = Worker()

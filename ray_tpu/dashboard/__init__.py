@@ -60,7 +60,9 @@ async function refresh() {
                                             "error"]) +
     "<h2>Jobs</h2>" + table(jobs, ["job_id", "driver", "alive"]) +
     `<p><a href="/metrics">/metrics</a> (Prometheus) · ` +
-    `<a href="/timeseries">/timeseries</a> (utilization)</p>`;
+    `<a href="/timeseries">/timeseries</a> (utilization) · ` +
+    `<a href="/api/telemetry?format=text">/api/telemetry</a> ` +
+    `(goodput/MFU)</p>`;
 }
 refresh(); setInterval(refresh, 3000);
 </script></body></html>
@@ -112,6 +114,21 @@ def create_app(address: Optional[str] = None):
     async def metrics(_req):
         return web.Response(text=await call(state_api.metrics_text),
                             content_type="text/plain")
+
+    async def telemetry(req):
+        """/api/telemetry — the training telemetry plane: cluster
+        goodput summary, per-step train series, collective latency,
+        serve ingress, flight-recorder dumps (`rt telemetry` JSON)."""
+        from ..util import telemetry as telemetry_mod
+
+        summary = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: telemetry_mod.cluster_summary(address=address))
+        if req.query.get("format") == "text":
+            return web.Response(
+                text=telemetry_mod.render_text(summary),
+                content_type="text/plain")
+        return web.json_response(
+            json.loads(json.dumps(summary, default=repr)))
 
     async def timeseries_json(req):
         return web.json_response(json.loads(json.dumps(
@@ -225,6 +242,7 @@ def create_app(address: Optional[str] = None):
     app.router.add_get("/api/stack", stack)
     app.router.add_get("/api/profile", profile)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/api/telemetry", telemetry)
     app.router.add_get("/timeseries", timeseries)
     app.router.add_get("/api/timeseries", timeseries_json)
     return app

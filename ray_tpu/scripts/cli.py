@@ -358,6 +358,24 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_telemetry(args) -> int:
+    """Training telemetry plane: cluster goodput summary, per-step
+    train series, collective latency/bandwidth, serve ingress, and
+    flight-recorder dumps from dead workers."""
+    from ray_tpu.util import telemetry as telemetry_mod
+
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    summary = telemetry_mod.cluster_summary(address=address)
+    if args.format == "json":
+        print(json.dumps(summary, indent=2, default=repr))
+        return 0
+    sys.stdout.write(telemetry_mod.render_text(summary))
+    return 0
+
+
 def _job_client(address: str):
     import ray_tpu
     from ray_tpu.job import JobSubmissionClient
@@ -618,6 +636,14 @@ def _build_parser() -> argparse.ArgumentParser:
                         help="print Prometheus metrics exposition")
     sp.add_argument("--address", default="")
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("telemetry",
+                        help="training telemetry: goodput, MFU, "
+                             "collectives, flight recorder")
+    sp.add_argument("--address", default="")
+    sp.add_argument("--format", choices=["text", "json"],
+                    default="text")
+    sp.set_defaults(fn=cmd_telemetry)
 
     sp = sub.add_parser("dashboard", help="serve the web dashboard")
     sp.add_argument("--address", default="")

@@ -12,7 +12,51 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Any, Dict, Optional
+
+
+class _IngressTelemetry:
+    """Per-proxy request metrics: latency histogram by deployment +
+    outcome, and an in-flight depth gauge (the proxy-side queue depth
+    — requests accepted but not yet answered)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = 0
+
+    def begin(self) -> float:
+        with self._lock:
+            self._inflight += 1
+            # Gauge set stays under the lock: interleaved begin/end
+            # pairs must not publish a stale depth out of order.
+            self._set_inflight(self._inflight)
+        return time.perf_counter()
+
+    def end(self, t0: float, deployment: str, outcome: str) -> None:
+        with self._lock:
+            self._inflight -= 1
+            self._set_inflight(self._inflight)
+        try:
+            from ..util.metrics import Histogram
+
+            Histogram("rt_serve_request_seconds",
+                      "HTTP ingress request latency.",
+                      tag_keys=("deployment", "outcome")).observe(
+                time.perf_counter() - t0,
+                tags={"deployment": deployment, "outcome": outcome})
+        except Exception:
+            pass
+
+    def _set_inflight(self, depth: int) -> None:
+        try:
+            from ..util.metrics import Gauge
+
+            Gauge("rt_serve_inflight",
+                  "Requests accepted but not yet answered.").set(
+                float(depth))
+        except Exception:
+            pass
 
 
 class HTTPProxy:
@@ -30,13 +74,16 @@ class HTTPProxy:
         self._port = port
         self._actual_port = None
         self._ready = threading.Event()
+        self._telemetry = _IngressTelemetry()
 
-        async def handler(request: "web.Request") -> "web.Response":
+        async def _handle(request: "web.Request",
+                          tel: Dict[str, str]) -> "web.Response":
             import ray_tpu
             from .controller import DeploymentHandle
 
             path = "/" + request.match_info.get("tail", "")
             target = self._route_table.resolve(path)
+            tel["deployment"] = target or "?"
             if target is None:
                 return web.json_response(
                     {"error": f"no route for {path}"}, status=404)
@@ -103,6 +150,18 @@ class HTTPProxy:
                                    type(None))):
                 return web.json_response({"result": result})
             return web.json_response({"result": repr(result)})
+
+        async def handler(request: "web.Request") -> "web.Response":
+            t0 = self._telemetry.begin()
+            tel = {"deployment": "?"}
+            outcome = "error"
+            try:
+                resp = await _handle(request, tel)
+                outcome = ("ok" if resp.status < 400
+                           else f"http_{resp.status}")
+                return resp
+            finally:
+                self._telemetry.end(t0, tel["deployment"], outcome)
 
         def run_server():
             loop = asyncio.new_event_loop()

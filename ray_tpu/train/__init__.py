@@ -10,10 +10,10 @@ from .train_step import (TrainState, make_optimizer,  # noqa: F401
                          make_sharded_train_step, make_train_step)
 from .checkpoint import Checkpoint, CheckpointManager  # noqa: F401
 from .config import (CheckpointConfig, FailureConfig, Result,  # noqa
-                     RunConfig, ScalingConfig)
-from .session import (checkpoint_dir, get_checkpoint,  # noqa: F401
-                      get_dataset_shard, get_local_rank, get_world_rank,
-                      get_world_size, report)
+                     RunConfig, ScalingConfig, TelemetryConfig)
+from .session import (checkpoint_dir, data_wait,  # noqa: F401
+                      get_checkpoint, get_dataset_shard, get_local_rank,
+                      get_world_rank, get_world_size, report)
 from .trainer import (DataParallelTrainer, JaxTrainer,  # noqa: F401
                       TorchTrainer)
 from .worker_group import WorkerGroup  # noqa: F401

@@ -19,6 +19,25 @@ from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
 
+@contextmanager
+def _timed_ckpt(metric: str):
+    """Attribute checkpoint I/O to the goodput ledger and observe its
+    duration histogram (save vs restore)."""
+    from ..util import goodput
+
+    t0 = time.monotonic()
+    with goodput.ledger().phase("checkpoint"):
+        yield
+    try:
+        from ..util.metrics import Histogram
+
+        Histogram(metric,
+                  "Checkpoint payload save/restore duration."
+                  ).observe(time.monotonic() - t0)
+    except Exception:
+        pass
+
+
 class Checkpoint:
     def __init__(self, path: str):
         self.path = os.path.abspath(path)
@@ -41,18 +60,22 @@ class Checkpoint:
     def save_pytree(self, name: str, tree: Any) -> None:
         from flax import serialization
 
-        os.makedirs(self.path, exist_ok=True)
-        with open(os.path.join(self.path, name + ".msgpack"), "wb") as f:
-            f.write(serialization.to_bytes(tree))
+        with _timed_ckpt("rt_train_checkpoint_save_seconds"):
+            os.makedirs(self.path, exist_ok=True)
+            with open(os.path.join(self.path, name + ".msgpack"),
+                      "wb") as f:
+                f.write(serialization.to_bytes(tree))
 
     def load_pytree(self, name: str, target: Any = None) -> Any:
         from flax import serialization
 
-        with open(os.path.join(self.path, name + ".msgpack"), "rb") as f:
-            data = f.read()
-        if target is None:
-            return serialization.msgpack_restore(data)
-        return serialization.from_bytes(target, data)
+        with _timed_ckpt("rt_train_checkpoint_restore_seconds"):
+            with open(os.path.join(self.path, name + ".msgpack"),
+                      "rb") as f:
+                data = f.read()
+            if target is None:
+                return serialization.msgpack_restore(data)
+            return serialization.from_bytes(target, data)
 
     def save_json(self, name: str, obj: Dict) -> None:
         os.makedirs(self.path, exist_ok=True)
@@ -88,7 +111,8 @@ class CheckpointManager:
         dest = os.path.join(self.run_dir,
                             f"checkpoint_{self._index:06d}")
         if os.path.abspath(source_dir) != dest:
-            shutil.copytree(source_dir, dest, dirs_exist_ok=True)
+            with _timed_ckpt("rt_train_checkpoint_save_seconds"):
+                shutil.copytree(source_dir, dest, dirs_exist_ok=True)
         score = None
         if self.score_attribute and metrics:
             score = metrics.get(self.score_attribute)

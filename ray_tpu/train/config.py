@@ -35,6 +35,46 @@ class FailureConfig:
     max_failures: int = 0
 
 
+# bf16 peak FLOPs/s per chip by TPU generation (public spec sheets) —
+# shared by the telemetry plane and bench.py.
+PEAK_FLOPS_BY_GEN: Dict[str, float] = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+@dataclass
+class TelemetryConfig:
+    """Declared model-cost figures the telemetry plane needs to turn
+    per-step reports into tokens/sec and achieved MFU gauges (the
+    runtime cannot derive FLOPs-per-token from a closed jit).
+
+    ``model_flops_per_token`` is the training cost (fwd+bwd) per token
+    — e.g. ``GPT2Config.flops_per_token()``.  With it unset (0) the
+    MFU gauge is simply not emitted; step-time and goodput metrics
+    work regardless.
+    """
+
+    model_flops_per_token: float = 0.0
+    tokens_per_step: float = 0.0       # per-worker tokens per report
+    peak_flops_per_device: float = 0.0  # 0 = resolve from the TPU gen
+    devices_per_worker: int = 1
+
+    def resolved_peak_flops(self) -> float:
+        if self.peak_flops_per_device > 0:
+            return self.peak_flops_per_device
+        env = os.environ.get("RT_PEAK_FLOPS_PER_DEVICE", "")
+        if env:
+            try:
+                return float(env)
+            except ValueError:
+                pass
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        return PEAK_FLOPS_BY_GEN.get(gen, PEAK_FLOPS_BY_GEN["v5e"])
+
+
 @dataclass
 class CheckpointConfig:
     num_to_keep: Optional[int] = None
@@ -49,6 +89,7 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(
         default_factory=CheckpointConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
     def resolved_storage_path(self) -> str:
         base = self.storage_path or os.path.expanduser("~/ray_tpu_results")

@@ -12,11 +12,13 @@ from __future__ import annotations
 
 import os
 import tempfile
+import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
 from .checkpoint import Checkpoint
+from .config import TelemetryConfig
 
 _session: Optional["TrainSession"] = None
 
@@ -33,11 +35,15 @@ class TrainSession:
     checkpoint: Optional[Checkpoint] = None
     dataset_shards: Dict[str, Any] = field(default_factory=dict)
     storage_dir: str = ""
+    telemetry: Optional[TelemetryConfig] = None
     _report_index: int = 0
+    _last_report_ts: Optional[float] = None
+    _clock: Any = time.monotonic  # injectable for telemetry tests
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
         self._report_index += 1
+        self._observe_step(metrics)
         payload = {"rank": self.world_rank, "metrics": dict(metrics),
                    "index": self._report_index,
                    "checkpoint_path": checkpoint.path if checkpoint
@@ -46,6 +52,43 @@ class TrainSession:
             import ray_tpu
 
             ray_tpu.get(self.result_queue.push.remote(payload))
+
+    def _observe_step(self, metrics: Dict[str, Any]) -> None:
+        """Per-step telemetry: the report cadence IS the step cadence,
+        so the delta between reports is the end-to-end step time (incl.
+        data wait + host overhead); tokens/sec and achieved MFU derive
+        from the declared TelemetryConfig figures."""
+        try:
+            from ..util.metrics import Gauge, Histogram
+
+            now = self._clock()
+            last, self._last_report_ts = self._last_report_ts, now
+            step = metrics.get("step", self._report_index)
+            Gauge("rt_train_step",
+                  "Latest reported training step.").set(float(step))
+            if last is None:
+                return
+            dt = max(now - last, 1e-9)
+            Histogram("rt_train_step_time_seconds",
+                      "Wall-clock between session.report calls "
+                      "(per-step time).").observe(dt)
+            tel = self.telemetry or TelemetryConfig()
+            tokens = float(metrics.get("tokens",
+                                       tel.tokens_per_step or 0.0))
+            if tokens <= 0:
+                return
+            tps = tokens / dt
+            Gauge("rt_train_tokens_per_sec",
+                  "Per-worker training throughput.").set(tps)
+            if tel.model_flops_per_token > 0:
+                peak = tel.resolved_peak_flops() * max(
+                    tel.devices_per_worker, 1)
+                Gauge("rt_train_mfu",
+                      "Achieved model FLOPs utilization (0-1) from "
+                      "the declared FLOPs-per-token figure.").set(
+                    tps * tel.model_flops_per_token / peak)
+        except Exception:
+            pass  # telemetry must never fail a training step
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.checkpoint
@@ -107,3 +150,23 @@ def checkpoint_dir():
     """Scratch dir for building a checkpoint before report()."""
     d = tempfile.mkdtemp(prefix="rt_ckpt_build_")
     yield d
+
+
+@contextmanager
+def data_wait():
+    """Wrap the blocking part of fetching the next batch: attributes
+    the elapsed time to the ``data_stall`` goodput phase and observes
+    the per-step data-wait histogram."""
+    from ..util import goodput
+
+    t0 = time.monotonic()
+    with goodput.ledger().phase("data_stall"):
+        yield
+    try:
+        from ..util.metrics import Histogram
+
+        Histogram("rt_train_data_wait_seconds",
+                  "Time the step loop spent waiting on input data."
+                  ).observe(time.monotonic() - t0)
+    except Exception:
+        pass

@@ -82,8 +82,50 @@ def shard_state(state: TrainState, mesh, param_axes_fn, rules=None
 
 
 def make_sharded_train_step(loss_fn, optimizer, mesh=None,
-                            donate: bool = True):
+                            donate: bool = True, telemetry: bool = True):
     """Jit the step; with a mesh, shardings propagate from the state
-    placement (GSPMD), so no explicit in_shardings are needed."""
+    placement (GSPMD), so no explicit in_shardings are needed.
+
+    With ``telemetry`` (default), each call is timed host-side and
+    attributed to the goodput ledger: the first invocation (trace +
+    XLA compile) lands in the ``compile`` phase and sets the
+    ``rt_train_compile_seconds`` gauge; later invocations land in
+    ``compute`` and feed the dispatch-time histogram.  Host-side
+    timing under async dispatch is an approximation — the per-step
+    truth is the report-cadence ``rt_train_step_time_seconds``.
+    """
     step = make_train_step(loss_fn, optimizer)
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    if not telemetry:
+        return jitted
+
+    import time as _time
+
+    from ..util import goodput
+
+    compiled = [False]
+
+    def timed_step(state, batch):
+        phase = "compute" if compiled[0] else "compile"
+        t0 = _time.perf_counter()
+        with goodput.ledger().phase(phase):
+            out = jitted(state, batch)
+        dt = _time.perf_counter() - t0
+        try:
+            from ..util.metrics import Gauge, Histogram
+
+            if not compiled[0]:
+                Gauge("rt_train_compile_seconds",
+                      "Host-side duration of the first (tracing + "
+                      "XLA compile) step invocation.").set(dt)
+            else:
+                Histogram("rt_train_step_dispatch_seconds",
+                          "Host-side duration of the jitted step call "
+                          "(approximate under async dispatch)."
+                          ).observe(dt)
+        except Exception:
+            pass
+        compiled[0] = True
+        return out
+
+    return timed_step

@@ -16,6 +16,7 @@ the global device view (ray_tpu.parallel) for DP/FSDP/TP/SP.
 
 from __future__ import annotations
 
+import os
 import time
 import uuid
 from typing import Any, Callable, Dict, Optional
@@ -110,7 +111,8 @@ class BaseTrainer:
                     self.train_loop_config, w.rank, sc.num_workers,
                     info, queue, start_ckpt.path if start_ckpt else None,
                     shard_specs[w.rank],
-                    self.run_config.name or "train_run"))
+                    self.run_config.name or "train_run",
+                    self.run_config.telemetry))
             final_metrics: Dict = {}
             pending = list(refs)
             while pending:
@@ -128,6 +130,7 @@ class BaseTrainer:
                 final_metrics = history[-1]["metrics"]
             return final_metrics
         finally:
+            self._push_driver_metrics(force=True)
             try:
                 backend.on_shutdown(group)
             except Exception:
@@ -147,6 +150,36 @@ class BaseTrainer:
                 payload["checkpoint_path"] = ckpt.path
             if payload["rank"] == 0:
                 history.append(payload)
+        self._push_driver_metrics()
+
+    def _push_driver_metrics(self, force: bool = False) -> None:
+        """Driver-side telemetry (goodput ledger, worker-group and
+        checkpoint metrics) has no heartbeat of its own — ship the
+        local registry to the controller on the drain cadence,
+        throttled to the metrics report period."""
+        now = time.time()
+        last = getattr(self, "_last_metrics_push", 0.0)
+        period = 2.0
+        try:
+            from ..core import runtime as runtime_mod
+
+            rt = runtime_mod.get_runtime_quiet()
+            if rt is None or not hasattr(rt, "controller_call"):
+                return
+            period = min(
+                2.0, getattr(rt.config, "metrics_report_period_s", 2.0))
+            if not force and now - last < period:
+                return
+            self._last_metrics_push = now
+            from ..util.metrics import registry
+
+            snap = registry().snapshot()
+            if snap:
+                rt.controller_call("report_metrics", {
+                    "source": f"driver-{os.getpid()}",
+                    "snapshot": snap})
+        except Exception:
+            pass  # telemetry must never fail the fit loop
 
     @staticmethod
     def _shard_dataset(ds, num_shards: int):
@@ -158,7 +191,7 @@ class BaseTrainer:
 
 
 def _worker_entry(train_loop, config, rank, world, local_info, queue,
-                  ckpt_path, shards, experiment_name):
+                  ckpt_path, shards, experiment_name, telemetry=None):
     """Runs inside the worker actor: set up the session, run user code."""
     from . import session as session_mod
     from .checkpoint import Checkpoint
@@ -171,10 +204,16 @@ def _worker_entry(train_loop, config, rank, world, local_info, queue,
         experiment_name=experiment_name,
         result_queue=queue,
         checkpoint=Checkpoint(ckpt_path) if ckpt_path else None,
-        dataset_shards=shards)
+        dataset_shards=shards,
+        telemetry=telemetry)
+    from ..util import flight_recorder
+
+    flight_recorder.record("train_worker_start", rank=rank,
+                           world=world, experiment=experiment_name)
     try:
         return train_loop(config)
     finally:
+        flight_recorder.record("train_worker_done", rank=rank)
         session_mod.shutdown_session()
 
 

@@ -134,14 +134,37 @@ class TrainControllerV2:
             trainer.run_config.failure_config.max_failures)
         self.state_history: List[Dict[str, Any]] = []
         self.attempt_sizes: List[int] = []
+        self._restarting = False
 
     def _transition(self, state: ControllerState, **info) -> None:
         self.state_history.append(
             {"state": state.value, "ts": time.time(), **info})
+        from ..util import flight_recorder
+
+        flight_recorder.record("train_state", state=state.value, **info)
+
+    def _mark_restart(self, active: bool) -> None:
+        """Attribute the gang-down window (failure detected -> next
+        attempt launches) to the ``restart`` goodput phase."""
+        from ..util import goodput
+
+        if active and not self._restarting:
+            goodput.ledger().enter("restart")
+            self._restarting = True
+        elif not active and self._restarting:
+            goodput.ledger().exit()
+            self._restarting = False
 
     def fit(self) -> Result:
-        self._transition(ControllerState.INITIALIZING)
+        import os
+
+        from ..util import flight_recorder
+
         run_dir = self.trainer.run_config.resolved_storage_path()
+        flight_recorder.install(
+            dump_dir=os.path.join(run_dir, "flight"),
+            source=f"driver-{os.getpid()}")
+        self._transition(ControllerState.INITIALIZING)
         ckpt_cfg = self.trainer.run_config.checkpoint_config
         manager = CheckpointManager(
             run_dir, num_to_keep=ckpt_cfg.num_to_keep,
@@ -152,6 +175,17 @@ class TrainControllerV2:
         history: List[Dict] = []
         failures = 0
         attempt = 0
+        try:
+            return self._fit_loop(manager, start_ckpt, history,
+                                  failures, attempt, run_dir)
+        finally:
+            # A raise during the next attempt's scheduling (or any
+            # abort) must not leave the process-global ledger stuck
+            # in the restart phase forever.
+            self._mark_restart(False)
+
+    def _fit_loop(self, manager, start_ckpt, history, failures,
+                  attempt, run_dir) -> Result:
         while True:
             self._transition(ControllerState.SCHEDULING,
                              attempt=attempt)
@@ -165,6 +199,7 @@ class TrainControllerV2:
                 self.trainer.scaling_config, num_workers=size)
             self.attempt_sizes.append(size)
             self._transition(ControllerState.RUNNING, workers=size)
+            self._mark_restart(False)
             try:
                 final = self.trainer._run_attempt(manager, start_ckpt,
                                                   history)
@@ -185,6 +220,7 @@ class TrainControllerV2:
                         error=e.cause, metrics_history=history)
                 self._transition(ControllerState.RESTARTING,
                                  failures=failures)
+                self._mark_restart(True)
                 start_ckpt = manager.latest()
                 attempt += 1
 

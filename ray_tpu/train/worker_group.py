@@ -10,6 +10,7 @@ detection surfaced as WorkerGroupError.
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
@@ -60,6 +61,7 @@ class WorkerGroup:
                  resources_per_worker: Optional[Dict[str, float]] = None,
                  placement_strategy: Optional[str] = None,
                  name_prefix: str = "train"):
+        t_start = time.monotonic()
         self.num_workers = num_workers
         self._pg = None
         res = dict(resources_per_worker or {"CPU": 1.0})
@@ -98,6 +100,18 @@ class WorkerGroup:
                                 for w in self.workers])
         for w, nid in zip(self.workers, node_ids):
             w.node_id = nid
+        try:
+            from ..util.metrics import Gauge, Histogram
+
+            Histogram("rt_train_worker_group_start_seconds",
+                      "Gang placement + actor spawn time for a "
+                      "training worker group.").observe(
+                time.monotonic() - t_start)
+            Gauge("rt_train_workers",
+                  "Workers in the most recent training gang.").set(
+                float(num_workers))
+        except Exception:
+            pass
 
     def local_ranks(self) -> List[Dict[str, int]]:
         """Per-worker local rank/size/node-rank from node placement."""

@@ -133,34 +133,41 @@ def main() -> None:
                         help="append results to the PERF.jsonl "
                              "regression ledger")
     parser.add_argument("--attempts", type=int, default=3,
-                        help="fresh-cluster attempts for --record; "
-                             "best per metric is kept (this host has "
-                             "multi-minute noisy-neighbor phases from "
-                             "the shared TPU relay; sustained capability "
-                             "is the quietest sample)")
+                        help="fresh-cluster attempts for --record; the "
+                             "MEDIAN per metric is recorded so the "
+                             "ledger reflects typical capability, not "
+                             "the quietest sample (the regression "
+                             "floors in perf_ledger.py are the "
+                             "documented contract)")
     args = parser.parse_args()
     owns = not ray_tpu.is_initialized()
     if owns:
         ray_tpu.init(mode="cluster", num_cpus=2)
     try:
         results = run(quick=args.quick)
+        attempts = {r["benchmark"]: [r] for r in results}
         if owns and args.record:
-            # Fresh-cluster attempts spread over time: the host sees
-            # multi-minute noisy-neighbor phases (shared TPU-relay
-            # box); sustained capability = the quietest attempt, the
-            # same reason ray_perf runs multiple trials.
+            # Fresh-cluster attempts spread over time so one
+            # noisy-neighbor phase (shared TPU-relay box) can't
+            # dominate every sample; the MEDIAN is what gets recorded.
             import time as _time
 
             for i in range(max(args.attempts - 1, 0)):
                 ray_tpu.shutdown()
                 _time.sleep(min(60.0 * i, 180.0))
                 ray_tpu.init(mode="cluster", num_cpus=2)
-                alt = run(quick=args.quick)
-                cur = {r["benchmark"]: r for r in results}
-                for r in alt:
-                    if r["per_sec"] > cur[r["benchmark"]]["per_sec"]:
-                        cur[r["benchmark"]] = r
-                results = list(cur.values())
+                for r in run(quick=args.quick):
+                    attempts[r["benchmark"]].append(r)
+            import statistics
+
+            results = []
+            for name, rows in attempts.items():
+                rows.sort(key=lambda r: r["per_sec"])
+                median = statistics.median(
+                    [r["per_sec"] for r in rows])
+                results.append({**rows[len(rows) // 2],
+                                "per_sec": round(median, 1),
+                                "attempts": len(rows)})
         for row in results:
             print(json.dumps(row))
     finally:

@@ -107,6 +107,14 @@ def metrics_history(*, source: Optional[str] = None,
     return _call("metrics_history", {"source": source}, address)
 
 
+def telemetry(*, address: Optional[str] = None) -> Dict[str, Any]:
+    """Raw training-telemetry feed: latest per-source metric snapshots
+    + retained flight-recorder dumps.  Use
+    ``ray_tpu.util.telemetry.cluster_summary`` for the aggregated
+    operator view (`rt telemetry`)."""
+    return _call("telemetry", {}, address)
+
+
 def timeline(filename: Optional[str] = None, *,
              address: Optional[str] = None) -> Any:
     """Chrome-trace (chrome://tracing / perfetto) export of task events

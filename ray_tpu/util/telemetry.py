@@ -1,0 +1,230 @@
+"""Cluster training-telemetry summary — the data behind ``rt telemetry``
+and the dashboard's ``/api/telemetry`` route.
+
+Pulls the controller's latest per-source metric snapshots (plus retained
+flight-recorder dumps) through the ``telemetry`` RPC and re-aggregates
+them into one operator-facing structure:
+
+  goodput      phase seconds/fractions summed across every process
+  train        per-source step / step-time / tokens-per-sec / MFU series
+  collectives  latency histograms + effective bus bandwidth by op
+  serve        ingress request latency + in-flight depth
+  flight       dumps forwarded from dead workers
+
+Everything here is read-side only: the write side is the process-local
+metric registries shipped on the existing heartbeat cadence.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+TRAIN_GAUGES = ("rt_train_step", "rt_train_tokens_per_sec",
+                "rt_train_mfu", "rt_train_compile_seconds",
+                "rt_train_workers")
+TRAIN_HISTS = ("rt_train_step_time_seconds",
+               "rt_train_data_wait_seconds",
+               "rt_train_checkpoint_save_seconds",
+               "rt_train_checkpoint_restore_seconds")
+
+
+def _hist_stats(boundaries: List[float], hist: Dict) -> Dict[str, float]:
+    count = hist.get("count", 0)
+    total = hist.get("sum", 0.0)
+    out = {"count": count, "sum": total,
+           "mean": (total / count) if count else 0.0}
+    out["p50"] = _hist_quantile(boundaries, hist.get("buckets", []),
+                                count, 0.5)
+    out["p99"] = _hist_quantile(boundaries, hist.get("buckets", []),
+                                count, 0.99)
+    return out
+
+
+def _hist_quantile(boundaries: List[float], buckets: List[int],
+                   count: int, q: float) -> float:
+    """Upper-bound estimate of the q-quantile from bucket counts (the
+    +Inf bucket reports the last finite boundary)."""
+    if not count or not buckets:
+        return 0.0
+    target = q * count
+    cum = 0
+    for i, c in enumerate(buckets):
+        cum += c
+        if cum >= target:
+            if i < len(boundaries):
+                return float(boundaries[i])
+            return float(boundaries[-1]) if boundaries else 0.0
+    return float(boundaries[-1]) if boundaries else 0.0
+
+
+def _iter_metrics(sources: Dict[str, List[Dict]]
+                  ) -> List[Tuple[str, Dict]]:
+    out = []
+    for src, snaps in (sources or {}).items():
+        for snap in snaps:
+            out.append((src, snap))
+    return out
+
+
+def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
+    """Assemble the full telemetry summary from a live controller."""
+    from . import goodput as goodput_mod
+    from . import state as state_api
+
+    raw = state_api.telemetry(address=address)
+    sources: Dict[str, List[Dict]] = raw.get("sources", {})
+    try:
+        history = state_api.metrics_history(address=address)
+    except Exception:
+        history = {}
+
+    # --- train: latest gauge values + histogram stats per source.
+    train: Dict[str, Dict[str, Any]] = {}
+    collectives: List[Dict[str, Any]] = []
+    serve: Dict[str, Any] = {}
+    for src, snap in _iter_metrics(sources):
+        name = snap.get("name", "")
+        if name in TRAIN_GAUGES:
+            row = train.setdefault(src, {})
+            for s in snap.get("series", []):
+                row[name] = float(s.get("value", 0.0))
+        elif name in TRAIN_HISTS:
+            row = train.setdefault(src, {})
+            for s in snap.get("series", []):
+                row[name] = _hist_stats(snap.get("boundaries", []),
+                                        s.get("hist", {}))
+        elif name == "rt_collective_latency_seconds":
+            for s in snap.get("series", []):
+                tags = s.get("tags") or {}
+                stats = _hist_stats(snap.get("boundaries", []),
+                                    s.get("hist", {}))
+                collectives.append({"source": src, **tags, **stats})
+        elif name == "rt_collective_bus_bandwidth_bytes_per_sec":
+            for s in snap.get("series", []):
+                tags = s.get("tags") or {}
+                for row in collectives:
+                    if row.get("source") == src and all(
+                            row.get(k) == v for k, v in tags.items()):
+                        row["bus_bytes_per_sec"] = float(
+                            s.get("value", 0.0))
+        elif name == "rt_serve_request_seconds":
+            for s in snap.get("series", []):
+                tags = s.get("tags") or {}
+                key = tags.get("deployment", "?")
+                serve.setdefault("requests", {})[key] = _hist_stats(
+                    snap.get("boundaries", []), s.get("hist", {}))
+        elif name == "rt_serve_inflight":
+            for s in snap.get("series", []):
+                serve["inflight"] = serve.get("inflight", 0.0) + float(
+                    s.get("value", 0.0))
+
+    # --- per-step time series from the controller's retained history.
+    series: Dict[str, List] = {}
+    for src, rows in (history or {}).items():
+        keep = []
+        for ts, vals in rows:
+            step_vals = {k: v for k, v in vals.items()
+                         if k.startswith("rt_train_")
+                         or k.startswith(goodput_mod.GAUGE_NAME)}
+            if step_vals:
+                keep.append([ts, step_vals])
+        if keep:
+            series[src] = keep
+
+    return {
+        "ts": raw.get("ts"),
+        "goodput": goodput_mod.summarize_sources(sources),
+        "train": train,
+        "train_series": series,
+        "collectives": collectives,
+        "serve": serve,
+        "flight": raw.get("flight", []),
+    }
+
+
+def _fmt_rate(v: float) -> str:
+    for unit, div in (("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{unit}"
+    return f"{v:.1f}"
+
+
+def render_text(summary: Dict[str, Any]) -> str:
+    """Human-readable telemetry report for the CLI."""
+    lines: List[str] = []
+    gp = summary.get("goodput", {})
+    lines.append("Goodput "
+                 f"(total {gp.get('total_seconds', 0.0):.1f}s across "
+                 f"{len(gp.get('per_source', {}))} source(s)):")
+    fracs = gp.get("fractions", {})
+    if not fracs:
+        lines.append("  (no goodput data reported yet)")
+    for phase in sorted(fracs, key=lambda p: -fracs[p]):
+        lines.append(f"  {phase:<11} {100 * fracs[phase]:6.2f}%  "
+                     f"({gp['seconds'][phase]:.2f}s)")
+
+    train = summary.get("train", {})
+    if train:
+        lines.append("\nTraining:")
+        for src in sorted(train):
+            row = train[src]
+            lines.append(f"  {src}:")
+            if "rt_train_step" in row:
+                lines.append(f"    step                {row['rt_train_step']:.0f}")
+            if "rt_train_tokens_per_sec" in row:
+                lines.append("    tokens/sec          "
+                             f"{_fmt_rate(row['rt_train_tokens_per_sec'])}")
+            if "rt_train_mfu" in row:
+                lines.append(f"    MFU                 "
+                             f"{100 * row['rt_train_mfu']:.2f}%")
+            st = row.get("rt_train_step_time_seconds")
+            if st:
+                lines.append(f"    step time           mean "
+                             f"{st['mean'] * 1e3:.1f}ms  p50≤"
+                             f"{st['p50'] * 1e3:.1f}ms  n={st['count']}")
+            dw = row.get("rt_train_data_wait_seconds")
+            if dw and dw["count"]:
+                lines.append(f"    data wait           mean "
+                             f"{dw['mean'] * 1e3:.1f}ms  n={dw['count']}")
+            for key, label in (
+                    ("rt_train_checkpoint_save_seconds", "ckpt save"),
+                    ("rt_train_checkpoint_restore_seconds",
+                     "ckpt restore")):
+                h = row.get(key)
+                if h and h["count"]:
+                    lines.append(f"    {label:<19} mean "
+                                 f"{h['mean'] * 1e3:.1f}ms  n={h['count']}")
+
+    cols = summary.get("collectives", [])
+    if cols:
+        lines.append("\nCollectives:")
+        for row in cols:
+            bw = row.get("bus_bytes_per_sec")
+            lines.append(
+                f"  {row.get('op', '?'):<14} backend={row.get('backend', '?')}"
+                f" world={row.get('world', '?')}  n={row['count']}  "
+                f"mean {row['mean'] * 1e3:.2f}ms"
+                + (f"  busbw {_fmt_rate(bw)}B/s" if bw else ""))
+
+    serve = summary.get("serve", {})
+    if serve.get("requests"):
+        lines.append("\nServe ingress:")
+        for dep, h in sorted(serve["requests"].items()):
+            lines.append(f"  {dep:<20} n={h['count']}  mean "
+                         f"{h['mean'] * 1e3:.1f}ms  p99≤"
+                         f"{h['p99'] * 1e3:.1f}ms")
+        lines.append(f"  in-flight now: {serve.get('inflight', 0):.0f}")
+
+    flights = summary.get("flight", [])
+    if flights:
+        lines.append("\nFlight recorder dumps:")
+        for d in flights:
+            last = (d.get("sticky") or {}).get("last_task") or {}
+            lines.append(f"  {d.get('source', '?')}  "
+                         f"reason={d.get('reason', '?')!r}  "
+                         f"events={len(d.get('events', []))}"
+                         + (f"  last_task={last.get('name')}"
+                            f"[{last.get('state')}]" if last else "")
+                         + (f"  path={d['path']}" if d.get("path")
+                            else ""))
+    return "\n".join(lines) + "\n"

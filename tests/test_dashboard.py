@@ -75,6 +75,10 @@ def test_dashboard_endpoints():
         else:
             raise TimeoutError("task never appeared in dashboard")
         assert "rt_nodes_alive" in fetch("/metrics")
+        telem = json.loads(fetch("/api/telemetry"))
+        assert {"goodput", "train", "collectives", "serve",
+                "flight"} <= set(telem)
+        assert "Goodput" in fetch("/api/telemetry?format=text")
         loop.call_soon_threadsafe(loop.stop)
     finally:
         ray_tpu.shutdown()
