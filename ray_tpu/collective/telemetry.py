@@ -64,6 +64,23 @@ def record_op(op: str, backend: str, world_size: int, nbytes: int,
         pass  # telemetry must never fail a collective
 
 
+def _record_span(op: str, backend: str, world_size: int,
+                 t0_wall: float, error: str = "") -> None:
+    """Timeline span for one collective, tagged op/backend/world — the
+    cluster timeline shows WHICH collective a rank sat in, not just the
+    latency histogram the metrics carry."""
+    try:
+        from ..util import spans
+
+        tags = {"op": op, "backend": backend, "world": str(world_size)}
+        if error:
+            tags["error"] = error
+        spans.record_span(op, t0_wall, time.time(), cat="collective",
+                          tags=tags)
+    except Exception:
+        pass
+
+
 @contextmanager
 def timed_op(op: str, backend: str, world_size: int, nbytes: int = 0):
     # Flight-record the START too: a worker preempted mid-collective
@@ -78,6 +95,7 @@ def timed_op(op: str, backend: str, world_size: int, nbytes: int = 0):
     except Exception:
         flight_recorder = None
     t0 = time.perf_counter()
+    t0_wall = time.time()
     try:
         yield
     except BaseException as e:
@@ -85,6 +103,8 @@ def timed_op(op: str, backend: str, world_size: int, nbytes: int = 0):
             flight_recorder.record(
                 "collective_error", op=op, error=repr(e),
                 seconds=round(time.perf_counter() - t0, 6))
+        _record_span(op, backend, world_size, t0_wall, error=repr(e))
         raise
     record_op(op, backend, world_size, nbytes,
               time.perf_counter() - t0)
+    _record_span(op, backend, world_size, t0_wall)

@@ -1243,6 +1243,7 @@ class ClusterRuntime(BaseRuntime):
             while True:
                 st.request_agents[rid] = agent_addr
                 agent = await self._agent_for(agent_addr)
+                payload["owner_tag"] = self._owner_tag_for(agent_addr)
                 grant = await agent.call("request_lease", payload)
                 if grant is None:
                     raise RemoteCallError(RuntimeError(
@@ -1412,6 +1413,7 @@ class ClusterRuntime(BaseRuntime):
         while True:
             sub.agent_addr = agent_addr
             agent = await self._agent_for(agent_addr)
+            payload["owner_tag"] = self._owner_tag_for(agent_addr)
             logger.debug("lease req %s -> %s (hops=%d)",
                          spec.display_name(), agent_addr, hops)
             grant = await agent.call("request_lease", payload)
@@ -1459,6 +1461,14 @@ class ClusterRuntime(BaseRuntime):
                 pass
 
     _peer_agent_clients: Dict[str, RpcClient]
+
+    def _owner_tag_for(self, agent_addr: str) -> str:
+        """The connection tag this process uses toward ``agent_addr`` —
+        sent with lease requests so the agent can reclaim leases whose
+        owner process died without returning them (the agent watches
+        the tagged connection; see node_agent._on_owner_conn_lost)."""
+        return (f"rt-{os.getpid()}" if agent_addr == self.agent_addr
+                else f"rt-peer-{self._runtime_id}")
 
     async def _agent_for(self, addr: str) -> RpcClient:
         if addr == self.agent_addr:

@@ -101,6 +101,18 @@ class Controller:
         # Flight-recorder dumps forwarded by node agents when a worker
         # dies (bounded; newest wins per source).
         self.flight_dumps: "OrderedDict[str, Dict]" = OrderedDict()
+        # Cross-process span sink (collectives, train-step phases,
+        # serve requests, explicit tracing spans) drained from every
+        # worker/driver ring on the heartbeat cadence; merged with
+        # task_records by the cluster timeline export.
+        from collections import deque as _deque
+
+        self.span_records: "_deque[Dict]" = _deque(
+            maxlen=self.config.task_event_buffer_size)
+        self.spans_received = 0
+        # On-demand profiler artifacts (e.g. jax.profiler trace dirs)
+        # reported by node agents after an `rt profile --jax` capture.
+        self.profile_artifacts: "_deque[Dict]" = _deque(maxlen=64)
         self._agent_clients: Dict[NodeID, RpcClient] = {}
         self._placement = None  # PlacementGroupManager, attached in setup
         self._shutdown = asyncio.Event()
@@ -121,6 +133,7 @@ class Controller:
             "list_jobs", "report_metrics", "metrics_text",
             "metrics_history", "get_load_metrics", "worker_logs",
             "telemetry", "report_flight_dump",
+            "report_spans", "list_spans", "report_profile",
         ]:
             self.server.register(name, getattr(self, name))
 
@@ -794,6 +807,44 @@ class Controller:
             self.flight_dumps.popitem(last=False)
         return {"ok": True}
 
+    async def report_spans(self, p):
+        """Span records drained from a process's ring (relayed by its
+        node agent, or pushed directly by the driver).  The sink is one
+        bounded deque — oldest spans fall off first, same policy as the
+        task-event sink."""
+        src = p.get("source") or "?"
+        node = p.get("node_id")
+        for s in p.get("spans") or []:
+            s.setdefault("source", src)
+            if node and not s.get("node_id"):
+                s["node_id"] = node
+            self.span_records.append(s)
+            self.spans_received += 1
+        return {"ok": True}
+
+    async def list_spans(self, p):
+        limit = (p or {}).get("limit", 10000)
+        cat = (p or {}).get("cat")
+        out = []
+        for s in reversed(self.span_records):
+            if cat and s.get("cat") != cat:
+                continue
+            out.append(s)
+            if len(out) >= limit:
+                break
+        out.reverse()  # chronological-ish (ring append order)
+        return {"spans": out, "total": len(self.span_records),
+                "received": self.spans_received}
+
+    async def report_profile(self, p):
+        """A node agent reports a finished on-demand profiler capture
+        (artifact stays on the node's disk; this records where)."""
+        self.profile_artifacts.append({
+            "source": p.get("source", "?"), "kind": p.get("kind", "jax"),
+            "path": p.get("path", ""), "node_id": p.get("node_id"),
+            "ts": p.get("ts") or time.time()})
+        return {"ok": True}
+
     def _prune_metrics_sources(self, now: float) -> None:
         """Drop sources that stopped reporting (dead workers/nodes) —
         a gauge from a dead process must not render as current, and
@@ -812,7 +863,8 @@ class Controller:
         return {"ts": now,
                 "sources": {s: v["snapshot"]
                             for s, v in self.metrics_sources.items()},
-                "flight": list(self.flight_dumps.values())}
+                "flight": list(self.flight_dumps.values()),
+                "profiles": list(self.profile_artifacts)}
 
     def _prune_metrics_history(self, now: float) -> None:
         """Dead sources must not leak deques under worker churn (the

@@ -68,6 +68,13 @@ class Lease:
     pg_id: Optional[PlacementGroupID] = None
     bundle_index: int = -1
     blocked: bool = False
+    # Connection tag of the OWNER process holding this lease (task/pool
+    # leases only; actor leases are owned by the actor worker itself
+    # and released on its exit).  Lets the agent reclaim leases whose
+    # owner died without returning them — e.g. an actor killed while
+    # caching a lease for reuse — instead of stranding the leased
+    # worker and its resources forever.
+    owner_tag: str = ""
 
 
 @dataclass
@@ -143,7 +150,8 @@ class NodeAgent:
             "request_lease", "return_lease", "lease_status",
             "cancel_lease_request",
             "register_worker", "worker_heartbeat",
-            "report_task_events", "report_metrics",
+            "report_task_events", "report_metrics", "report_spans",
+            "jax_profile_workers",
             "task_blocked", "task_unblocked", "report_backlog",
             "register_object", "pull_object", "fetch_raw", "fetch_chunk",
             "delete_object", "make_room",
@@ -155,6 +163,11 @@ class NodeAgent:
             "stack_worker",
         ]:
             self.server.register(name, getattr(self, name))
+        # Reclaim leases whose owner process died without returning
+        # them (found via the new tracing tests: a killed actor that
+        # had cached a task lease for reuse strands the leased worker
+        # and its CPUs forever, starving every later task).
+        self.server.on_connection_lost(self._on_owner_conn_lost)
 
     # -------------------------------------------------------------- startup
     async def start(self, port: int = 0) -> int:
@@ -571,6 +584,52 @@ class NodeAgent:
             pass
         return {"ok": True}
 
+    async def report_spans(self, p):
+        """Relay a worker's drained span ring to the controller's span
+        sink (workers have no persistent controller connection; this
+        is the same relay report_task_events rides)."""
+        p.setdefault("node_id", self.node_id.hex())
+        try:
+            await self._ctl.call("report_spans", p)
+        except RpcError:
+            pass
+        return {"ok": True}
+
+    async def jax_profile_workers(self, p):
+        """Fan an on-demand jax.profiler capture out to every live
+        worker on this node (ref: the reference dashboard's
+        profile_manager; here the capture runs in-process on the
+        worker and the artifact path is reported back through the
+        controller so `rt profile --jax` can list it cluster-wide)."""
+        req = {"duration_s": p.get("duration_s", 3.0),
+               "log_dir": p.get("log_dir"), "force": p.get("force")}
+
+        async def _one(w):
+            cli = RpcClient(w.addr, tag="jaxprof")
+            try:
+                r = await cli.call("jax_profile", req)
+            except RpcError as e:
+                r = {"ok": False, "error": str(e)}
+            finally:
+                await cli.close()
+            return {"pid": w.pid, "worker_id": w.worker_id.hex(), **r}
+
+        results = await asyncio.gather(
+            *[_one(w) for w in list(self.workers.values())])
+        for r in results:
+            if r.get("ok") and r.get("path"):
+                try:
+                    await self._ctl.call("report_profile", {
+                        "source": f"worker-{self.node_id.hex()[:8]}"
+                                  f"-{r['pid']}",
+                        "kind": "jax", "path": r["path"],
+                        "node_id": self.node_id.hex(),
+                        "ts": time.time()})
+                except RpcError:
+                    pass
+        return {"ok": True, "node_id": self.node_id.hex(),
+                "results": list(results)}
+
     def _host_cpu_util(self) -> float:
         """Host CPU utilization since the previous sample, from
         /proc/stat deltas (ref: dashboard/modules/reporter/
@@ -784,10 +843,25 @@ class NodeAgent:
         if w is None:
             _refund()
             return None
+        owner_tag = ("" if payload.get("is_actor")
+                     else payload.get("owner_tag") or "")
+        if owner_tag and not self.server.has_peer(owner_tag):
+            # The owner's connection vanished while we were granting
+            # (e.g. killed mid worker spawn).  Recording the lease now
+            # would strand it forever — the conn-lost sweep already ran
+            # and found nothing to reclaim.  No await separates this
+            # check from the record below, so the sweep and this guard
+            # can never both miss.
+            _refund()
+            self._idle_q.append(w)
+            self._worker_ready.set()
+            self._kick_scheduler()
+            return {"ok": False, "cancelled": True}
         lease = Lease(
             lease_id=next(self._lease_counter), resources=demand, worker=w,
             chip_ids=chip_ids, pg_id=payload.get("pg_id"),
-            bundle_index=payload.get("bundle_index", -1))
+            bundle_index=payload.get("bundle_index", -1),
+            owner_tag=owner_tag)
         w.state = "actor" if payload.get("is_actor") else "leased"
         w.lease_id = lease.lease_id
         if payload.get("job_id"):
@@ -870,6 +944,10 @@ class NodeAgent:
             holds = self._infeasible_holds = {}
         if rid:
             holds[rid] = rec
+            hold_owners = getattr(self, "_hold_owner_tags", None)
+            if hold_owners is None:
+                hold_owners = self._hold_owner_tags = {}
+            hold_owners[rid] = p.get("owner_tag") or ""
         deadline = asyncio.get_event_loop().time() + \
             (p.get("queue_timeout") or 3600.0)
         try:
@@ -892,6 +970,7 @@ class NodeAgent:
             infeasible.remove(rec)
             if rid:
                 holds.pop(rid, None)
+                getattr(self, "_hold_owner_tags", {}).pop(rid, None)
 
     async def _pick_remote(self, demand: ResourceSet,
                            strategy: str,
@@ -971,6 +1050,105 @@ class NodeAgent:
         for k, cap in self.total.amounts.items():
             if self.available.amounts.get(k, 0.0) > cap:
                 self.available.amounts[k] = cap
+
+    def _on_owner_conn_lost(self, tag: str) -> None:
+        """A registered peer's connection dropped.  If that peer owns
+        leases or queued lease requests, schedule a grace-delayed
+        reclamation — a dead owner can never return them, and the
+        stranded workers would hold their resources forever."""
+        if not tag:
+            return
+        owns = any(l.owner_tag == tag for l in self.leases.values()) \
+            or any(req.payload.get("owner_tag") == tag
+                   for req in self.pending) \
+            or tag in getattr(self, "_hold_owner_tags", {}).values()
+        watching = getattr(self, "_reclaim_watch", None)
+        if watching is None:
+            watching = self._reclaim_watch = set()
+        if owns and tag not in watching:
+            watching.add(tag)
+            spawn_task(self._reclaim_owner_leases(tag))
+
+    async def _await_owner_death(self, tag: str,
+                                 grace_s: float) -> bool:
+        """True once the owner behind ``tag`` is confirmed gone, False
+        if it reconnected.  rt-<pid> owners are processes on THIS node
+        (only a runtime talking to its local agent uses that tag), so
+        their liveness is checked directly — and re-checked on a slow
+        cadence while the process lives, because the reclaim trigger is
+        edge-based (the connection already dropped; if the owner dies
+        later WITHOUT reconnecting, no further event fires).  rt-peer-*
+        owners are remote; for them the grace window is the only
+        signal, so a transient cross-node drop CAN cost a live owner
+        its leased workers — that degrades to the worker_failed path
+        (the owner's submit loop resubmits the failed task), a bounded
+        retry, versus the forever-leak reclaiming too late would be."""
+        local_pid = (int(tag[3:])
+                     if tag.startswith("rt-") and tag[3:].isdigit()
+                     else None)
+        while True:
+            await asyncio.sleep(grace_s)
+            if self.server.has_peer(tag):
+                return False
+            if local_pid is None:
+                return True
+            try:
+                os.kill(local_pid, 0)
+            except ProcessLookupError:
+                return True
+            except PermissionError:
+                return False  # pid exists (other user): not ours
+            if not any(l.owner_tag == tag
+                       for l in self.leases.values()):
+                return False  # nothing left to watch for
+            grace_s = 10.0  # alive local owner: keep watching
+
+    async def _reclaim_owner_leases(self, tag: str,
+                                    grace_s: float = 3.0) -> None:
+        """After a grace window (a transient reconnect re-registers the
+        tag on the owner's next call), free every lease the dead owner
+        still holds.  The leased workers are KILLED, not recycled: the
+        owner may have had a push in flight, and a worker with orphaned
+        work must not re-enter the idle pool (same rationale as
+        return_lease's worker_failed path)."""
+        try:
+            dead = await self._await_owner_death(tag, grace_s)
+        finally:
+            getattr(self, "_reclaim_watch", set()).discard(tag)
+        if not dead:
+            return  # owner reconnected; its leases are still live
+        # Cancel queued + autoscaler-held lease requests from the dead
+        # owner (a held infeasible demand would otherwise keep driving
+        # the autoscaler for up to queue_timeout).
+        hold_owners = getattr(self, "_hold_owner_tags", {})
+        for rid in [r for r, t in list(hold_owners.items())
+                    if t == tag]:
+            getattr(self, "_infeasible_holds", {}).pop(rid, None)
+            hold_owners.pop(rid, None)
+        for req in list(self.pending):
+            if req.payload.get("owner_tag") == tag \
+                    and not req.future.done():
+                req.future.set_result({"ok": False, "cancelled": True})
+                try:
+                    self.pending.remove(req)
+                except ValueError:
+                    pass
+        stale = [l for l in self.leases.values() if l.owner_tag == tag]
+        for lease in stale:
+            logger.warning(
+                "reclaiming lease %s (worker pid %s): owner %s is gone",
+                lease.lease_id, lease.worker.pid, tag)
+            self._release_lease(lease, worker_back=False)
+            w = lease.worker
+            w.state = "dead"
+            self.workers.pop(w.worker_id, None)
+            try:
+                if w.proc is not None:
+                    w.proc.kill()
+                else:
+                    os.kill(w.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
 
     async def cancel_lease_request(self, p):
         """Yank a queued-but-ungranted lease request (task cancellation;

@@ -159,6 +159,11 @@ class RpcServer:
         """cb(peer_tag) fires when a registered peer's connection drops."""
         self._conn_lost_cb = cb
 
+    def has_peer(self, tag: str) -> bool:
+        """Whether a peer with this tag is currently registered (a
+        reconnected peer re-registers on its next call)."""
+        return tag in self._conns
+
     async def start(self, port: int = 0) -> int:
         try:
             self._server = await asyncio.start_server(
@@ -237,12 +242,21 @@ class RpcServer:
                 spawn_task(self._dispatch(method, payload, req_id,
                                           send_frame, send_frame_bp))
         finally:
-            self._conns.pop(peer_tag, None)
-            if self._conn_lost_cb is not None:
-                try:
-                    self._conn_lost_cb(peer_tag)
-                except Exception:
-                    logger.exception("connection-lost callback failed")
+            # A peer that reconnected re-registered its tag with a NEW
+            # writer; when the superseded connection's reader finally
+            # errors out, it must neither clobber the live registration
+            # nor fire the lost callback (which would, e.g., reclaim a
+            # live owner's leases in the node agent).
+            cur = self._conns.get(peer_tag)
+            superseded = cur is not None and cur is not writer
+            if not superseded:
+                self._conns.pop(peer_tag, None)
+                if self._conn_lost_cb is not None:
+                    try:
+                        self._conn_lost_cb(peer_tag)
+                    except Exception:
+                        logger.exception(
+                            "connection-lost callback failed")
             try:
                 writer.close()
             except Exception:

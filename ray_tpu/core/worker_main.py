@@ -94,7 +94,7 @@ class Worker:
         for name in ["push_task", "exec_batch", "create_actor",
                      "push_actor_task", "exec_actor",
                      "cancel_task", "ping", "exit", "dump_stack",
-                     "profile", "stream_ack"]:
+                     "profile", "jax_profile", "stream_ack"]:
             self.server.register(name, getattr(self, name))
 
     async def start(self) -> None:
@@ -146,8 +146,11 @@ class Worker:
                                  state=state, task_id=ev["task_id"])
 
     async def _flush_loop(self) -> None:
-        """Ship task events + metric snapshots on one cadence."""
+        """Ship task events + span drains + metric snapshots on one
+        cadence (the span ring rides the same agent -> controller relay
+        as task events; see util/spans.py)."""
         period = max(self.config.metrics_report_period_s, 0.25)
+        source = f"worker-{self.node_id_hex[:8]}-{os.getpid()}"
         last_metrics = 0.0
         while True:
             await asyncio.sleep(min(period, 1.0))
@@ -157,6 +160,14 @@ class Worker:
                 if batch:
                     await self._agent.call("report_task_events",
                                            {"events": batch})
+                from ray_tpu.util import spans as spans_mod
+
+                span_batch = spans_mod.drain()
+                if span_batch:
+                    await self._agent.call("report_spans", {
+                        "source": source,
+                        "node_id": self.node_id_hex,
+                        "spans": span_batch})
                 now = time.time()
                 if now - last_metrics >= period:
                     last_metrics = now
@@ -165,8 +176,7 @@ class Worker:
                     snap = registry().snapshot()
                     if snap:
                         await self._agent.call("report_metrics", {
-                            "source": f"worker-{self.node_id_hex[:8]}"
-                                      f"-{os.getpid()}",
+                            "source": source,
                             "snapshot": snap})
             except RpcError:
                 pass  # agent gone; _watch_agent will exit us
@@ -802,17 +812,21 @@ class Worker:
         # concurrent async methods would cross-contaminate it (object
         # IDs stay unique regardless: the put counter is process-global).
         loop = asyncio.get_event_loop()
-        # Tracing parity with _execute_sync: async methods carry the
-        # submitter's span too.  (No set_span_context here — the
-        # thread-local would cross-contaminate concurrent coroutines,
-        # like the task-context note above; nested .remote() calls made
-        # from async methods are unattributed, a documented limit.)
+        # Tracing parity with _execute_sync: async methods execute AS a
+        # child span of the submitter's context.  Safe to set here: the
+        # span context is a contextvars.ContextVar and each RPC dispatch
+        # runs in its own asyncio task with its own context copy, so
+        # concurrent coroutines cannot cross-contaminate — and nested
+        # .remote() calls made from this method now inherit the span
+        # (previously a documented limitation of the thread-local).
         trace_extra = {}
+        span = None
         if spec.trace_ctx:
             from ..util import tracing as _tracing
 
-            trace_extra = dict(
-                _tracing.child_context(spec.trace_ctx) or {})
+            span = _tracing.child_context(spec.trace_ctx)
+            _tracing.set_span_context(span)
+            trace_extra = dict(span or {})
         self._emit_event(spec, "RUNNING", **trace_extra)
         try:
             # Arg resolution may block on remote objects; keep it off the
@@ -952,6 +966,43 @@ class Worker:
         folded = await asyncio.get_event_loop().run_in_executor(
             None, lambda: sample_profile(duration, hz))
         return {"ok": True, "folded": folded}
+
+    async def jax_profile(self, p):
+        """On-demand jax.profiler capture (`rt profile --jax`): trace
+        whatever this worker's jax runtime does for ``duration_s`` into
+        a TensorBoard-loadable directory and return its path.  Guarded:
+        jax is only touched if user code ALREADY imported it in this
+        process (tier-1 CPU runs and non-ML workers must never pay the
+        jax import); ``force`` opts into importing it anyway."""
+        if "jax" not in sys.modules and not p.get("force"):
+            return {"ok": False,
+                    "error": "jax not imported in this worker "
+                             "(pass force=True to load it)"}
+        duration = min(float(p.get("duration_s", 3.0)), 120.0)
+        log_dir = p.get("log_dir") or os.path.join(
+            self.config.session_dir_root, self.session, "profiles",
+            f"jax-{self.node_id_hex[:8]}-{os.getpid()}-"
+            f"{int(time.time())}")
+
+        def _capture():
+            import jax
+
+            os.makedirs(log_dir, exist_ok=True)
+            jax.profiler.start_trace(log_dir)
+            try:
+                # The capture window: jax activity on OTHER threads
+                # (the train loop) lands in the trace while we sleep.
+                time.sleep(duration)
+            finally:
+                jax.profiler.stop_trace()
+            return log_dir
+
+        try:
+            path = await asyncio.get_event_loop().run_in_executor(
+                None, _capture)
+        except BaseException as e:  # noqa: BLE001 — shipped to caller
+            return {"ok": False, "error": repr(e)}
+        return {"ok": True, "path": path}
 
     async def run_forever(self):
         await self._exit_event.wait()

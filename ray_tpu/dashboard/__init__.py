@@ -62,7 +62,8 @@ async function refresh() {
     `<p><a href="/metrics">/metrics</a> (Prometheus) · ` +
     `<a href="/timeseries">/timeseries</a> (utilization) · ` +
     `<a href="/api/telemetry?format=text">/api/telemetry</a> ` +
-    `(goodput/MFU)</p>`;
+    `(goodput/MFU) · ` +
+    `<a href="/api/timeline">/api/timeline</a> (Perfetto trace)</p>`;
 }
 refresh(); setInterval(refresh, 3000);
 </script></body></html>
@@ -129,6 +130,20 @@ def create_app(address: Optional[str] = None):
                 content_type="text/plain")
         return web.json_response(
             json.loads(json.dumps(summary, default=repr)))
+
+    async def timeline(req):
+        """/api/timeline — the unified cluster timeline as Chrome-trace
+        JSON (save it and load in Perfetto/chrome://tracing);
+        ?summary=1 returns the per-step critical-path summary instead
+        (slowest rank + dominant wait, `rt timeline --summary`)."""
+        want_summary = req.query.get("summary", "").lower() \
+            not in ("", "0", "false", "no")
+        if want_summary:
+            data = await call(state_api.timeline_summary)
+        else:
+            data = await call(state_api.cluster_timeline)
+        return web.json_response(
+            json.loads(json.dumps(data, default=repr)))
 
     async def timeseries_json(req):
         return web.json_response(json.loads(json.dumps(
@@ -243,6 +258,7 @@ def create_app(address: Optional[str] = None):
     app.router.add_get("/api/profile", profile)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/api/telemetry", telemetry)
+    app.router.add_get("/api/timeline", timeline)
     app.router.add_get("/timeseries", timeseries)
     app.router.add_get("/api/timeseries", timeseries_json)
     return app

@@ -336,15 +336,70 @@ def cmd_list(args) -> int:
 
 
 def cmd_timeline(args) -> int:
+    """Chrome-trace export.  Default: driver-local task events only;
+    ``--cluster``: the unified cluster timeline (task events + the
+    cross-process span plane + MFU/goodput/serve counter tracks +
+    flow arrows); ``--summary``: per-step critical path text instead
+    of a file.  Load exports at https://ui.perfetto.dev or
+    chrome://tracing."""
     from ray_tpu.util import state as state_api
 
     address = resolve_address(address=args.address)
     if not address:
         print("No running cluster found.", file=sys.stderr)
         return 1
-    trace = state_api.timeline(args.out, address=address)
+    if args.summary:
+        from ray_tpu.util.timeline import render_summary
+
+        sys.stdout.write(render_summary(
+            state_api.timeline_summary(address=address)))
+        return 0
+    if args.cluster:
+        trace = state_api.cluster_timeline(args.out, address=address)
+    else:
+        trace = state_api.timeline(args.out, address=address)
     print(f"Wrote {len(trace)} trace events to {args.out}")
     return 0
+
+
+def cmd_profile(args) -> int:
+    """On-demand profiler capture on live workers.  ``--jax`` runs a
+    jax.profiler trace on every worker that has jax loaded and prints
+    the artifact directories (TensorBoard-loadable; also recorded in
+    the controller telemetry feed)."""
+    from ray_tpu.util import state as state_api
+
+    if not args.jax:
+        print("error: pass --jax (sampling profiles are served via "
+              "/api/profile on the dashboard)", file=sys.stderr)
+        return 2
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    # Workers clamp the capture to 120s; clamp here too so the
+    # reported window matches what was actually captured.
+    if args.duration > 120.0:
+        print("note: capture window clamped to 120s", file=sys.stderr)
+        args.duration = 120.0
+    results = state_api.jax_profile(
+        duration_s=args.duration, node_id=args.node or None,
+        force=args.force, address=address)
+    if not results:
+        print("(no live workers found)")
+        return 1
+    captured = 0
+    for r in results:
+        nid = str(r.get("node_id", "?"))[:12]
+        if r.get("ok"):
+            captured += 1
+            print(f"  {nid} pid={r['pid']:<8} {r['path']}")
+        else:
+            print(f"  {nid} pid={r['pid']:<8} skipped: "
+                  f"{r.get('error')}")
+    print(f"{captured}/{len(results)} worker(s) captured "
+          f"({args.duration:.1f}s window)")
+    return 0 if captured else 1
 
 
 def cmd_metrics(args) -> int:
@@ -627,10 +682,31 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_list)
 
     sp = sub.add_parser("timeline",
-                        help="export Chrome-trace of task events")
+                        help="export a Chrome-trace/Perfetto timeline")
     sp.add_argument("--out", default="timeline.json")
+    sp.add_argument("--cluster", action="store_true",
+                    help="merged cluster timeline: task events + "
+                         "cross-process spans + counter tracks + "
+                         "flow arrows")
+    sp.add_argument("--summary", action="store_true",
+                    help="print the per-step critical path (slowest "
+                         "rank + dominant wait) instead of a file")
     sp.add_argument("--address", default="")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("profile",
+                        help="on-demand profiler capture on workers")
+    sp.add_argument("--jax", action="store_true",
+                    help="jax.profiler trace on workers with jax "
+                         "loaded (TensorBoard-loadable artifacts)")
+    sp.add_argument("--duration", type=float, default=3.0,
+                    help="capture window seconds (default 3)")
+    sp.add_argument("--node", default="", help="node id prefix filter")
+    sp.add_argument("--force", action="store_true",
+                    help="import jax into workers that have not "
+                         "loaded it yet")
+    sp.add_argument("--address", default="")
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("metrics",
                         help="print Prometheus metrics exposition")

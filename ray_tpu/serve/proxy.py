@@ -37,14 +37,25 @@ class _IngressTelemetry:
         with self._lock:
             self._inflight -= 1
             self._set_inflight(self._inflight)
+        elapsed = time.perf_counter() - t0
         try:
             from ..util.metrics import Histogram
 
             Histogram("rt_serve_request_seconds",
                       "HTTP ingress request latency.",
                       tag_keys=("deployment", "outcome")).observe(
-                time.perf_counter() - t0,
+                elapsed,
                 tags={"deployment": deployment, "outcome": outcome})
+        except Exception:
+            pass
+        try:
+            from ..util import spans
+
+            wall_end = time.time()
+            spans.record_span(deployment or "?", wall_end - elapsed,
+                              wall_end, cat="serve",
+                              tags={"deployment": deployment,
+                                    "outcome": outcome})
         except Exception:
             pass
 

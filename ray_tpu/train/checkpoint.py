@@ -14,7 +14,6 @@ import json
 import os
 import shutil
 import tempfile
-import time
 from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
@@ -25,17 +24,10 @@ def _timed_ckpt(metric: str):
     duration histogram (save vs restore)."""
     from ..util import goodput
 
-    t0 = time.monotonic()
-    with goodput.ledger().phase("checkpoint"):
+    with goodput.timed_phase(
+            "checkpoint", metric,
+            "Checkpoint payload save/restore duration."):
         yield
-    try:
-        from ..util.metrics import Histogram
-
-        Histogram(metric,
-                  "Checkpoint payload save/restore duration."
-                  ).observe(time.monotonic() - t0)
-    except Exception:
-        pass
 
 
 class Checkpoint:

@@ -72,6 +72,20 @@ class TrainSession:
             Histogram("rt_train_step_time_seconds",
                       "Wall-clock between session.report calls "
                       "(per-step time).").observe(dt)
+            # Timeline span per step, tagged step/rank: the cluster
+            # timeline's per-rank step rows and the `rt timeline
+            # --summary` critical path (slowest rank per step) are
+            # built from these.
+            try:
+                from ..util import spans
+
+                wall_end = time.time()
+                spans.record_span(
+                    "step", wall_end - dt, wall_end, cat="train_step",
+                    tags={"step": int(float(step)),
+                          "rank": self.world_rank})
+            except Exception:
+                pass
             tel = self.telemetry or TelemetryConfig()
             tokens = float(metrics.get("tokens",
                                        tel.tokens_per_step or 0.0))
@@ -159,14 +173,7 @@ def data_wait():
     the per-step data-wait histogram."""
     from ..util import goodput
 
-    t0 = time.monotonic()
-    with goodput.ledger().phase("data_stall"):
+    with goodput.timed_phase(
+            "data_stall", "rt_train_data_wait_seconds",
+            "Time the step loop spent waiting on input data."):
         yield
-    try:
-        from ..util.metrics import Histogram
-
-        Histogram("rt_train_data_wait_seconds",
-                  "Time the step loop spent waiting on input data."
-                  ).observe(time.monotonic() - t0)
-    except Exception:
-        pass

@@ -171,6 +171,7 @@ class BaseTrainer:
             if not force and now - last < period:
                 return
             self._last_metrics_push = now
+            from ..util import spans
             from ..util.metrics import registry
 
             snap = registry().snapshot()
@@ -178,6 +179,9 @@ class BaseTrainer:
                 rt.controller_call("report_metrics", {
                     "source": f"driver-{os.getpid()}",
                     "snapshot": snap})
+            # Driver-side spans (goodput phases, start_span blocks)
+            # ride the same cadence into the controller span sink.
+            spans.flush(source=f"driver-{os.getpid()}")
         except Exception:
             pass  # telemetry must never fail the fit loop
 

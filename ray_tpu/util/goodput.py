@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import threading
 import time
+from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional
 
 PHASES = ("compute", "compile", "checkpoint", "restart", "data_stall",
@@ -75,13 +76,16 @@ class GoodputLedger:
         self._t0 = clock()
         self._seconds: Dict[str, float] = {
             p: 0.0 for p in PHASES if p != "idle"}
-        self._stack: List[str] = []
+        # Stack entries are (phase_name, wall_clock_start): the wall
+        # timestamp turns every exit into a timeline span (see
+        # util/spans.py) in addition to the cumulative-seconds gauge.
+        self._stack: List[tuple] = []
         self._mark = self._t0
 
     # ------------------------------------------------------------ transitions
     def _attribute(self, now: float) -> None:
         if self._stack:
-            self._seconds[self._stack[-1]] += now - self._mark
+            self._seconds[self._stack[-1][0]] += now - self._mark
         self._mark = now
 
     def enter(self, name: str) -> None:
@@ -91,7 +95,7 @@ class GoodputLedger:
                 f"{sorted(self._seconds)} — 'idle' is derived)")
         with self._lock:
             self._attribute(self._clock())
-            self._stack.append(name)
+            self._stack.append((name, time.time()))
         self._republish()
 
     def exit(self) -> None:
@@ -99,8 +103,16 @@ class GoodputLedger:
             if not self._stack:
                 return
             self._attribute(self._clock())
-            self._stack.pop()
+            name, wall_t0 = self._stack.pop()
         self._republish()
+        if self._publish:
+            try:
+                from . import spans
+
+                spans.record_span(name, wall_t0, time.time(),
+                                  cat="phase")
+            except Exception:
+                pass  # telemetry must never take down training
 
     def phase(self, name: str) -> _PhaseSpan:
         """``with ledger().phase("compute"): ...``"""
@@ -163,6 +175,28 @@ def reset() -> GoodputLedger:
     with _ledger_lock:
         _ledger = GoodputLedger()
     return _ledger
+
+
+@contextmanager
+def timed_phase(phase: str, metric: Optional[str] = None,
+                description: str = ""):
+    """Attribute a block to a goodput phase and (optionally) observe
+    its duration histogram — the shared shape behind
+    ``train.data_wait`` and checkpoint save/restore timing.  Ledger
+    attribution covers the block even when it raises; the histogram
+    observes only on success (a failed wait/save has no meaningful
+    duration sample)."""
+    t0 = time.monotonic()
+    with ledger().phase(phase):
+        yield
+    if metric:
+        try:
+            from .metrics import Histogram
+
+            Histogram(metric, description).observe(
+                time.monotonic() - t0)
+        except Exception:
+            pass  # telemetry must never fail the training path
 
 
 # ------------------------------------------------------------- aggregation

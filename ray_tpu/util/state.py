@@ -118,37 +118,76 @@ def telemetry(*, address: Optional[str] = None) -> Dict[str, Any]:
 def timeline(filename: Optional[str] = None, *,
              address: Optional[str] = None) -> Any:
     """Chrome-trace (chrome://tracing / perfetto) export of task events
-    (ref: ray.timeline, _private/state.py:960).
+    (ref: ray.timeline, _private/state.py:960).  Task-only, driver-local
+    view; ``cluster_timeline`` is the merged cluster-wide export.
+
+    Still-RUNNING tasks export as an ``X`` clipped to now with
+    ``args.state == "RUNNING"`` — an unmatched ``B`` renders as an
+    unclosed/zero-length slice in Perfetto.
 
     Returns the trace list; writes JSON to ``filename`` if given.
     """
+    import time as _time
+
+    from .timeline import build_trace
+
     tasks = list_tasks(limit=100000, address=address)
-    trace: List[Dict] = []
-    for rec in tasks:
-        times = rec.get("times", {})
-        start = times.get("RUNNING")
-        end = times.get("FINISHED") or times.get("FAILED")
-        row = {"pid": f"node:{rec.get('node_id', '?')[:8]}",
-               "tid": f"worker:{rec.get('worker_pid', '?')}"}
-        if start is None:
-            continue
-        if end is None:
-            trace.append({"ph": "B", "name": rec.get("name", "?"),
-                          "ts": start * 1e6, "cat": "task",
-                          "args": {"task_id": rec["task_id"],
-                                   "state": rec.get("state")}, **row})
-        else:
-            trace.append({
-                "ph": "X", "name": rec.get("name", "?"),
-                "ts": start * 1e6, "dur": max(end - start, 0) * 1e6,
-                "cat": "task",
-                "args": {"task_id": rec["task_id"],
-                         "state": rec.get("state"),
-                         "error": rec.get("error")}, **row})
+    trace = build_trace(tasks, now=_time.time())
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+def list_spans(*, limit: int = 10000, cat: Optional[str] = None,
+               address: Optional[str] = None) -> List[Dict]:
+    """Span records from the controller's cross-process span sink
+    (collectives, goodput phases, train steps, serve requests,
+    explicit tracing spans)."""
+    r = _call("list_spans", {"limit": limit, "cat": cat}, address)
+    return r["spans"]
+
+
+def cluster_timeline(filename: Optional[str] = None, *,
+                     address: Optional[str] = None) -> List[Dict]:
+    """The unified cluster timeline: task events + the cross-process
+    span plane + MFU/goodput/serve counter tracks merged into ONE
+    Chrome-trace export — one ``pid`` track per node, ``tid`` per
+    worker, flow arrows linking submitter spans to their remote
+    executions (ref: ray.timeline + OTel span injection, redesigned
+    over the controller span sink).
+
+    Returns the trace list; writes JSON to ``filename`` if given.
+    """
+    import time as _time
+
+    from . import spans as spans_mod
+    from .timeline import build_trace
+
+    # Ship this process's own ring first so driver-side spans make the
+    # export (workers ride their agent flush loop; the driver has none).
+    spans_mod.flush()
+    tasks = list_tasks(limit=100000, address=address)
+    spans = list_spans(limit=100000, address=address)
+    try:
+        history = metrics_history(address=address)
+    except Exception:
+        history = {}
+    trace = build_trace(tasks, spans, history, now=_time.time())
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+def timeline_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
+    """Per-step critical path from the span sink: slowest rank per
+    training step + the goodput phase that dominated its wait (the
+    ``rt timeline --summary`` data)."""
+    from .timeline import critical_path_summary
+
+    return critical_path_summary(list_spans(limit=100000,
+                                            address=address))
 
 
 def summarize_tasks(*, address: Optional[str] = None) -> Dict[str, int]:
@@ -231,6 +270,43 @@ def profile_worker(*, worker_id: Optional[str] = None,
         if r.get("ok"):
             return r["folded"]
     raise ValueError("worker not found on any alive node")
+
+
+def jax_profile(*, duration_s: float = 3.0,
+                node_id: Optional[str] = None,
+                force: bool = False,
+                address: Optional[str] = None) -> List[Dict]:
+    """Start an on-demand ``jax.profiler`` capture on every live worker
+    (optionally filtered by node prefix) and return
+    [{node_id, pid, ok, path|error}, ...].  Workers that never imported
+    jax are skipped unless ``force`` (the tier-1 CPU guard); artifact
+    paths are also reported to the controller (``telemetry()`` →
+    ``profiles``)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    nodes = _agents(node_id, address)
+    if not nodes:
+        return []
+
+    def _one(n):
+        try:
+            r = _agent_call(n["agent_addr"], "jax_profile_workers",
+                            {"duration_s": duration_s, "force": force})
+        except Exception as e:  # noqa: BLE001 — one dead agent must
+            # not discard every other node's finished capture
+            return [{"node_id": n["node_id"], "pid": -1, "ok": False,
+                     "error": f"agent unreachable: {e}"}]
+        return [{"node_id": n["node_id"], **rec}
+                for rec in r.get("results", [])]
+
+    # Concurrent fan-out: every node captures the SAME wall-clock
+    # window, so one distributed train step shows up on all ranks
+    # (sequential capture would record disjoint windows).
+    out: List[Dict] = []
+    with ThreadPoolExecutor(max_workers=min(len(nodes), 16)) as ex:
+        for rows in ex.map(_one, nodes):
+            out.extend(rows)
+    return out
 
 
 def stack_worker(*, worker_id: Optional[str] = None,

@@ -9,6 +9,14 @@ spans are recorded as task events (the existing sink) with trace
 fields, and ``trace_tree()`` reassembles the cross-process call tree.
 Enable with ``RT_TRACING_ENABLED=1`` (config flag tracing_enabled).
 
+The active context lives in a ``contextvars.ContextVar``: every thread
+gets its own context (the old ``threading.local`` behavior for sync
+task execution), and every asyncio task gets a *copy* of its spawner's
+context — so concurrent async actor methods each adopt their own span
+without cross-contaminating siblings, and nested ``.remote()`` calls
+made from an async method inherit the method's span (see
+core/worker_main.py _run_async_method).
+
 Usage (driver side)::
 
     with tracing.start_span("ingest"):
@@ -17,18 +25,13 @@ Usage (driver side)::
 
 from __future__ import annotations
 
+import contextvars
 import os
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
-
-class _Ctx(threading.local):
-    def __init__(self):
-        self.current: Optional[Dict[str, str]] = None
-
-
-_ctx = _Ctx()
+_current: "contextvars.ContextVar[Optional[Dict[str, str]]]" = \
+    contextvars.ContextVar("rt_span_ctx", default=None)
 
 
 def _new_id(nbytes: int = 8) -> str:
@@ -37,17 +40,21 @@ def _new_id(nbytes: int = 8) -> str:
 
 def current_span_context() -> Optional[Dict[str, str]]:
     """{"trace_id", "span_id"} of the active span, or None."""
-    return _ctx.current
+    return _current.get()
 
 
 def set_span_context(ctx: Optional[Dict[str, str]]) -> None:
     """Adopt a propagated context (the worker does this around task
-    execution, so nested .remote() calls nest under the task's span)."""
-    _ctx.current = dict(ctx) if ctx else None
+    execution, so nested .remote() calls nest under the task's span).
+    Scoped to the current thread or asyncio task — setting it inside
+    one coroutine never leaks into a concurrently-running sibling."""
+    _current.set(dict(ctx) if ctx else None)
 
 
 class start_span:
-    """Context manager opening a span under the current one."""
+    """Context manager opening a span under the current one.  On exit
+    the finished span is also recorded into the process span ring
+    (util/spans.py) so it shows up in the cluster timeline."""
 
     def __init__(self, name: str):
         self.name = name
@@ -55,7 +62,7 @@ class start_span:
         self.ctx: Dict[str, str] = {}
 
     def __enter__(self) -> "start_span":
-        parent = _ctx.current
+        parent = _current.get()
         self.ctx = {
             "trace_id": (parent or {}).get("trace_id") or _new_id(16),
             "span_id": _new_id(),
@@ -64,18 +71,25 @@ class start_span:
             self.ctx["parent_span_id"] = parent["span_id"]
         self._prev = parent
         self._t0 = time.time()
-        _ctx.current = self.ctx
+        _current.set(self.ctx)
         return self
 
     def __exit__(self, *exc):
-        _ctx.current = self._prev
+        _current.set(self._prev)
+        try:
+            from . import spans as _spans
+
+            _spans.record_span(self.name, self._t0, time.time(),
+                               cat="span", trace=self.ctx)
+        except Exception:
+            pass  # the timeline must never fail user code
         return False
 
 
 def inject(spec) -> None:
     """Submit-side: attach the current span context to a TaskSpec
     (ref: tracing_helper.py _inject_tracing_into_function)."""
-    ctx = _ctx.current
+    ctx = _current.get()
     if ctx is not None:
         spec.trace_ctx = {"trace_id": ctx["trace_id"],
                           "parent_span_id": ctx["span_id"]}

@@ -1,0 +1,307 @@
+"""Unified cluster timeline: Chrome-trace builder unit coverage,
+critical-path summary math, the jax/aiohttp-free import guard, and the
+`rt timeline` / /api/timeline CLI guard with tracing DISABLED (the
+enabled-side guard lives in test_tracing_timeseries.py, whose cluster
+runs with tracing_enabled=True).
+
+Ref: ray.timeline (_private/state.py:960) + OTel span injection
+(tracing_helper.py:88) — ISSUE 2 (observability tentpole).
+"""
+
+import contextlib
+import io
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from ray_tpu.util.timeline import (build_trace, critical_path_summary,
+                                   render_summary)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------- pure builder
+def _task(tid, name, node, pid, times, state, span=None, parent=None):
+    rec = {"task_id": tid, "name": name, "node_id": node,
+           "worker_pid": pid, "times": times, "state": state}
+    if span:
+        rec["span_id"] = span
+    if parent:
+        rec["parent_span_id"] = parent
+    return rec
+
+
+def test_build_trace_tasks_spans_flows_and_metadata():
+    now = 1000.0
+    tasks = [
+        _task("t1", "mid", "aaaa1111bbbb", 11,
+              {"RUNNING": 10.0, "FINISHED": 12.0}, "FINISHED",
+              span="s-mid", parent="s-root"),
+        _task("t2", "leaf", "cccc2222dddd", 22,
+              {"RUNNING": 10.5, "FINISHED": 11.5}, "FINISHED",
+              span="s-leaf", parent="s-mid"),
+        # Still running: must clip to `now`, never emit a "B".
+        _task("t3", "stuck", "aaaa1111bbbb", 11,
+              {"RUNNING": 990.0}, "RUNNING"),
+        # Never started: not drawable.
+        _task("t4", "queued", "aaaa1111bbbb", 11, {}, "PENDING"),
+    ]
+    spans = [
+        {"name": "root", "cat": "span", "start": 9.5, "end": 12.5,
+         "pid": 7, "source": "driver-7", "span_id": "s-root"},
+        {"name": "allreduce", "cat": "collective", "start": 10.6,
+         "end": 10.9, "pid": 22, "node_id": "cccc2222dddd",
+         "source": "worker-cccc2222-22",
+         "tags": {"op": "allreduce", "backend": "cpu", "world": "2"}},
+    ]
+    trace = build_trace(tasks, spans, history=None, now=now)
+
+    assert not [e for e in trace if e.get("ph") == "B"]
+    for ev in trace:
+        assert "pid" in ev and "tid" in ev and "ts" in ev, ev
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+
+    xs = {e["name"]: e for e in trace if e["ph"] == "X"}
+    assert "queued" not in xs
+    stuck = xs["stuck"]
+    assert stuck["args"]["state"] == "RUNNING"
+    assert stuck["dur"] == pytest.approx((now - 990.0) * 1e6)
+
+    # Collective span keeps its tags and shares the worker's track
+    # with that worker's task slices (both keyed by OS pid 22).
+    coll = xs["allreduce"]
+    assert coll["args"]["op"] == "allreduce"
+    assert (coll["pid"], coll["tid"]) == (xs["leaf"]["pid"],
+                                          xs["leaf"]["tid"])
+
+    # Flow arrows: root(driver) -> mid(node A) -> leaf(node B); every
+    # s has a matching f on a DIFFERENT track, ts ordered.
+    s_evs = [e for e in trace if e.get("ph") == "s"]
+    f_evs = [e for e in trace if e.get("ph") == "f"]
+    assert sorted(e["id"] for e in s_evs) == \
+        sorted(e["id"] for e in f_evs)
+    assert len(s_evs) == 2
+    by_id = {e["id"]: [e] for e in s_evs}
+    for e in f_evs:
+        by_id[e["id"]].append(e)
+    for s_ev, f_ev in by_id.values():
+        assert (s_ev["pid"], s_ev["tid"]) != (f_ev["pid"], f_ev["tid"])
+        assert f_ev["ts"] >= s_ev["ts"]
+        assert f_ev.get("bp") == "e"
+
+    # Three processes named via metadata: 2 nodes + the driver.
+    pnames = {e["args"]["name"] for e in trace
+              if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert {"node:aaaa1111", "node:cccc2222", "driver-7"} <= pnames
+
+    # JSON-serializable as-is (the export contract).
+    json.loads(json.dumps(trace))
+
+
+def test_build_trace_counter_tracks_from_history():
+    history = {
+        "worker-aaaa-1": [
+            [100.0, {"rt_train_mfu": 0.31,
+                     "rt_goodput_seconds{phase=compute}": 5.0,
+                     "rt_goodput_seconds{phase=data_stall}": 1.0}],
+            [101.0, {"rt_train_mfu": 0.35}],
+        ],
+        "proxy-1": [[100.5, {"rt_serve_inflight": 3.0}]],
+        "agent-1": [[100.0, {"rt_node_cpu_util": 0.5}]],  # no counters
+    }
+    trace = build_trace([], [], history, now=200.0)
+    cs = [e for e in trace if e.get("ph") == "C"]
+    mfu = [e for e in cs if e["name"] == "MFU"]
+    assert [e["args"]["mfu"] for e in mfu] == [0.31, 0.35]
+    gp = next(e for e in cs if e["name"] == "goodput_seconds")
+    assert gp["args"] == {"compute": 5.0, "data_stall": 1.0}
+    inflight = next(e for e in cs if e["name"] == "serve_inflight")
+    assert inflight["args"]["inflight"] == 3.0
+    # The no-counter source contributes no counter track.
+    pnames = {e["args"]["name"] for e in trace
+              if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert not any("agent-1" in n for n in pnames)
+
+
+# ---------------------------------------------------- critical path
+def _step_span(step, rank, start, end, source):
+    return {"name": "step", "cat": "train_step", "start": start,
+            "end": end, "source": source,
+            "tags": {"step": step, "rank": rank}}
+
+
+def _phase_span(name, start, end, source):
+    return {"name": name, "cat": "phase", "start": start, "end": end,
+            "source": source}
+
+
+def test_critical_path_names_slowest_rank_and_dominant_wait():
+    spans = [
+        _step_span(1, 0, 10.0, 10.2, "w0"),
+        _step_span(1, 1, 10.0, 10.9, "w1"),      # slowest
+        _phase_span("data_stall", 10.1, 10.7, "w1"),
+        _phase_span("checkpoint", 10.7, 10.8, "w1"),
+        _phase_span("data_stall", 10.05, 10.1, "w0"),  # other source
+        _step_span(2, 0, 11.0, 11.8, "w0"),      # slowest
+        _step_span(2, 1, 11.0, 11.1, "w1"),
+        _phase_span("compute", 11.0, 11.7, "w0"),  # compute excluded
+    ]
+    summary = critical_path_summary(spans)
+    rows = {r["step"]: r for r in summary["steps"]}
+    assert rows[1]["slowest_rank"] == 1
+    assert rows[1]["dominant_wait"] == "data_stall"
+    assert rows[1]["wait_s"] == pytest.approx(0.6)
+    assert rows[1]["step_time_s"] == pytest.approx(0.9)
+    assert rows[2]["slowest_rank"] == 0
+    assert rows[2]["dominant_wait"] == "compute"  # no non-compute wait
+    text = render_summary(summary)
+    assert "rank 1" in text and "data_stall" in text
+    assert "step     1" in text or "step 1" in text.replace("  ", " ")
+
+
+def test_critical_path_empty_renders_hint():
+    assert "no train_step spans" in render_summary(
+        critical_path_summary([]))
+
+
+# -------------------------------------------------- import guard
+def test_trace_plane_imports_without_jax_or_aiohttp():
+    """The span ring, timeline builder, state API, and tracing glue
+    must import (and build a trace) on a box with neither jax nor
+    aiohttp installed — tier-1 CPU guard."""
+    script = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+
+        class _Block:
+            BLOCKED = ("jax", "aiohttp", "flax", "optax")
+            def find_module(self, name, path=None):
+                root = name.split(".")[0]
+                return self if root in self.BLOCKED else None
+            def load_module(self, name):
+                raise ImportError(f"blocked import: {{name}}")
+
+        sys.meta_path.insert(0, _Block())
+        for mod in ("jax", "aiohttp"):
+            assert mod not in sys.modules
+
+        from ray_tpu.util import spans, tracing
+        from ray_tpu.util import state  # noqa: F401
+        from ray_tpu.util.timeline import (build_trace,
+                                           critical_path_summary)
+
+        with tracing.start_span("guard"):
+            spans.record_span("op", 1.0, 2.0, cat="collective",
+                              tags={{"op": "allreduce"}})
+        ring = spans.drain()
+        assert len(ring) == 2, ring
+        trace = build_trace(
+            [{{"task_id": "t", "name": "n", "node_id": "ab" * 8,
+               "worker_pid": 1, "times": {{"RUNNING": 1.0}},
+               "state": "RUNNING"}}],
+            ring, None, now=2.0)
+        assert any(e["ph"] == "X" for e in trace)
+        critical_path_summary(ring)
+        import json
+        json.dumps(trace)
+        print("GUARD_OK")
+    """)
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=120)
+    assert "GUARD_OK" in out.stdout, out.stderr + out.stdout
+
+
+# ------------------------------------ CLI guard, tracing DISABLED
+@pytest.fixture(scope="module")
+def rt_disabled():
+    import ray_tpu
+
+    handle = ray_tpu.init(mode="cluster", num_cpus=2,
+                          config={"metrics_report_period_s": 0.3})
+    yield handle
+    ray_tpu.shutdown()
+
+
+def test_cli_and_dashboard_timeline_with_tracing_disabled(rt_disabled,
+                                                          tmp_path):
+    """`rt timeline` (local and --cluster), --summary, and
+    /api/timeline all produce valid JSON/text when tracing is off —
+    the span plane simply has fewer records, never a crash."""
+    import asyncio
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu.scripts import cli as cli_mod
+
+    @ray_tpu.remote
+    def guard_task():
+        return 1
+
+    assert ray_tpu.get(guard_task.remote(), timeout=60) == 1
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        from ray_tpu.util import state as state_api
+
+        if any(t.get("name") == "guard_task"
+               and t.get("state") == "FINISHED"
+               for t in state_api.list_tasks()):
+            break
+        time.sleep(0.25)
+
+    addr = rt_disabled.controller_addr
+    for extra in ([], ["--cluster"]):
+        out = tmp_path / f"d{'_'.join(extra) or 'local'}.json"
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli_mod.main(["timeline", *extra, "--out", str(out),
+                               "--address", addr])
+        assert rc == 0, buf.getvalue()
+        loaded = json.loads(out.read_text())
+        assert isinstance(loaded, list)
+        assert any(e.get("ph") == "X" for e in loaded)
+        assert not [e for e in loaded if e.get("ph") == "B"]
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_mod.main(["timeline", "--summary", "--address", addr])
+    assert rc == 0
+    assert "no train_step spans" in buf.getvalue()
+
+    # /api/timeline serves the same export (+ ?summary=1).
+    from aiohttp import web
+
+    from ray_tpu.dashboard import create_app
+
+    async def serve_once():
+        app = create_app()
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        loop = asyncio.get_event_loop()
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}",
+                    timeout=30) as resp:
+                return resp.read().decode()
+
+        tl = await loop.run_in_executor(None, fetch, "/api/timeline")
+        summ = await loop.run_in_executor(
+            None, fetch, "/api/timeline?summary=1")
+        await runner.cleanup()
+        return tl, summ
+
+    tl, summ = asyncio.new_event_loop().run_until_complete(
+        serve_once())
+    data = json.loads(tl)
+    assert isinstance(data, list) and any(
+        e.get("ph") == "X" for e in data)
+    assert "steps" in json.loads(summ)

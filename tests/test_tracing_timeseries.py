@@ -142,3 +142,156 @@ def test_dashboard_timeseries_page(rt):
     assert "<svg" in html and "CPU util" in html
     data = _json.loads(js)
     assert data and all(isinstance(v, list) for v in data.values())
+
+
+def _wait(pred, timeout=30, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.25)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def test_trace_tree_spans_actor_calls_sync_and_async(rt):
+    """ONE tree: driver root -> actor calls -> nested tasks, across
+    processes.  The async method exercises the contextvars migration —
+    a nested .remote() made from an ASYNC actor method nests under the
+    method's span (previously a documented thread-local limitation at
+    worker_main)."""
+    @ray_tpu.remote
+    def tree_leaf():
+        return 1
+
+    @ray_tpu.remote
+    def async_leaf():
+        return 3
+
+    @ray_tpu.remote
+    class TreeAct:
+        def work(self):
+            import ray_tpu as r
+
+            return r.get(tree_leaf.remote(), timeout=60)
+
+        async def amethod(self):
+            from ray_tpu.core import runtime as rtm
+
+            ref = async_leaf.remote()
+            return await rtm.get_runtime().await_ref(ref)
+
+    a = TreeAct.remote()
+    with tracing.start_span("tree-root") as root:
+        assert ray_tpu.get(a.work.remote(), timeout=120) == 1
+        assert ray_tpu.get(a.amethod.remote(), timeout=120) == 3
+    trace_id = root.ctx["trace_id"]
+
+    want = {"TreeAct.work", "tree_leaf", "TreeAct.amethod",
+            "async_leaf"}
+
+    def grab():
+        spans = tracing.trace_tree(state_api.list_tasks(limit=1000),
+                                   trace_id).get(trace_id, [])
+        names = {s["name"] for s in spans}
+        return spans if want <= names else None
+
+    spans = _wait(grab, what="actor-call trace spans")
+    by_name = {s["name"]: s for s in spans}
+    work = by_name["TreeAct.work"]
+    leaf = by_name["tree_leaf"]
+    assert work["parent_span_id"] == root.ctx["span_id"]
+    assert leaf["parent_span_id"] == work["span_id"]
+    assert work["trace_id"] == leaf["trace_id"] == trace_id
+    method = by_name["TreeAct.amethod"]
+    aleaf = by_name["async_leaf"]
+    assert method["parent_span_id"] == root.ctx["span_id"]
+    assert aleaf["parent_span_id"] == method["span_id"], \
+        "nested .remote() from an async method lost the span context"
+    ray_tpu.kill(a)
+
+
+def test_running_task_exports_clipped_x_event(rt, tmp_path):
+    """A still-RUNNING task exports as an X clipped to now with
+    args.state == RUNNING — never as an unmatched B event."""
+    release = tmp_path / "release"
+
+    @ray_tpu.remote
+    def slow_running(release_path):
+        import os as _os
+
+        deadline = time.time() + 120
+        while not _os.path.exists(release_path) \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        return 1
+
+    ref = slow_running.remote(str(release))
+    try:
+        _wait(lambda: [t for t in
+                       state_api.list_tasks(name="slow_running")
+                       if t.get("state") == "RUNNING"] or None,
+              timeout=60, what="task to report RUNNING")
+        trace = state_api.timeline()
+    finally:
+        open(release, "w").close()
+    assert not [e for e in trace if e.get("ph") == "B"]
+    running = [e for e in trace if e.get("ph") == "X"
+               and e.get("name") == "slow_running"
+               and e.get("args", {}).get("state") == "RUNNING"]
+    assert running, [e for e in trace if e.get("name") == "slow_running"]
+    assert all(e["dur"] >= 0 for e in running)
+    assert ray_tpu.get(ref, timeout=120) == 1
+
+
+def test_cluster_timeline_schema_flows_and_cli(rt, tmp_path):
+    """Merged export schema: every event carries pid/tid/ts,
+    durations are non-negative, flow s/f ids pair up across different
+    tracks — and the `rt timeline [--cluster]` CLI path emits valid
+    JSON with tracing ENABLED."""
+    import contextlib
+    import io
+    import json as _json
+
+    from ray_tpu.scripts import cli as cli_mod
+
+    def grab():
+        trace = state_api.cluster_timeline()
+        if any(e.get("ph") == "s" for e in trace):
+            return trace
+        return None
+
+    # The nested-task/actor tests above produced cross-process
+    # parent/child spans; their flow arrows must appear.
+    trace = _wait(grab, what="a cross-process flow pair")
+
+    for ev in trace:
+        assert "pid" in ev and "tid" in ev and "ts" in ev, ev
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0, ev
+    assert not [e for e in trace if e.get("ph") == "B"]
+    s_ids = sorted(e["id"] for e in trace if e.get("ph") == "s")
+    f_ids = sorted(e["id"] for e in trace if e.get("ph") == "f")
+    assert s_ids and s_ids == f_ids, (s_ids, f_ids)
+    # Flow endpoints sit on different tracks (that is their point).
+    by_id = {}
+    for e in trace:
+        if e.get("ph") in ("s", "f"):
+            by_id.setdefault(e["id"], {})[e["ph"]] = e
+    for pair in by_id.values():
+        assert set(pair) == {"s", "f"}
+        assert (pair["s"]["pid"], pair["s"]["tid"]) != \
+            (pair["f"]["pid"], pair["f"]["tid"])
+    # Process/thread metadata names every track.
+    assert any(e.get("ph") == "M" and e.get("name") == "process_name"
+               for e in trace)
+
+    for extra in ([], ["--cluster"]):
+        out = tmp_path / f"t{'_'.join(extra) or 'local'}.json"
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli_mod.main(["timeline", *extra, "--out", str(out),
+                               "--address", rt.controller_addr])
+        assert rc == 0
+        loaded = _json.loads(out.read_text())
+        assert loaded and any(e.get("ph") == "X" for e in loaded)
