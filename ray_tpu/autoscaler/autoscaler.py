@@ -16,6 +16,7 @@ import asyncio
 import logging
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -73,6 +74,12 @@ class StandardAutoscaler:
         # provider_id -> launch time; protects just-launched nodes from
         # the idle reaper before they register.
         self._launch_times: Dict[str, float] = {}
+        # Decision ring: one record per reconcile tick that acted or
+        # hit unsatisfiable demand, mirrored to the controller so `rt
+        # doctor` can answer "why didn't it scale" without reading
+        # the autoscaler log (round-5 demand-blindness weakness).
+        self.decisions: "deque[Dict]" = deque(maxlen=128)
+        self._unsatisfied: List[Dict[str, float]] = []
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -110,8 +117,22 @@ class StandardAutoscaler:
         """One reconcile pass; returns {"launched": [...],
         "terminated": [...]} for tests/introspection."""
         lm = await self._cli.call("get_load_metrics", {})
+        self._unsatisfied: List[Dict[str, float]] = []
         launched = await self._scale_up(lm)
         terminated = await self._scale_down(lm)
+        n_demands = len(lm["pending_demands"]) + \
+            len(lm["pending_placement_groups"])
+        if launched or terminated or self._unsatisfied:
+            rec = {"ts": time.time(), "demands": n_demands,
+                   "launched": list(launched),
+                   "terminated": list(terminated),
+                   "unsatisfied": list(self._unsatisfied)}
+            self.decisions.append(rec)
+            try:
+                await self._cli.notify("report_autoscaler_decision",
+                                       rec)
+            except RpcError:
+                pass
         return {"launched": launched, "terminated": terminated}
 
     def _counts_by_type(self) -> Dict[str, int]:
@@ -175,6 +196,7 @@ class StandardAutoscaler:
                     capacity.append(cap)
                     break
             else:
+                self._unsatisfied.append(dict(demand))
                 logger.warning("demand %s fits no launchable node type",
                                demand)
         # Honor min_workers regardless of demand.

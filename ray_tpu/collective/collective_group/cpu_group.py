@@ -77,6 +77,11 @@ class CPUGroup:
         self.world_size = world_size
         self.rank = rank
         self._store = store
+        # Gang-op sequence number for the collective-entry watchdog:
+        # SPMD discipline means every rank issues the same gang ops in
+        # the same order, so op #N lines up across ranks (p2p send/recv
+        # are pairwise, not gang-wide, and do not advance it).
+        self._gang_seq = 0
         from ray_tpu.core.net import get_node_ip_address
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -188,16 +193,20 @@ class CPUGroup:
         _send_msg(self._hub, array)
         return _recv_msg(self._hub)
 
+    def _gang_op(self, op: str, nbytes: int = 0):
+        self._gang_seq += 1
+        return _telemetry.timed_op(op, "cpu", self.world_size, nbytes,
+                                   group_name=self.group_name,
+                                   rank=self.rank, seq=self._gang_seq)
+
     def allreduce(self, array, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         array = np.asarray(array)
-        with _telemetry.timed_op("allreduce", "cpu", self.world_size,
-                                 array.nbytes):
+        with self._gang_op("allreduce", array.nbytes):
             return self._allreduce(array, op)
 
     def allgather(self, array) -> List[np.ndarray]:
         array = np.asarray(array)
-        with _telemetry.timed_op("allgather", "cpu", self.world_size,
-                                 array.nbytes):
+        with self._gang_op("allgather", array.nbytes):
             if self.world_size == 1:
                 return [array]
             if self.rank == 0:
@@ -213,16 +222,14 @@ class CPUGroup:
     def reducescatter(self, array, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         """Reduce then return this rank's 1/world_size shard (axis 0)."""
         array = np.asarray(array)
-        with _telemetry.timed_op("reducescatter", "cpu",
-                                 self.world_size, array.nbytes):
+        with self._gang_op("reducescatter", array.nbytes):
             total = self._allreduce(array, op)
             shards = np.array_split(total, self.world_size, axis=0)
             return shards[self.rank]
 
     def broadcast(self, array, src_rank: int = 0) -> np.ndarray:
         arr = np.asarray(array)
-        with _telemetry.timed_op("broadcast", "cpu", self.world_size,
-                                 arr.nbytes):
+        with self._gang_op("broadcast", arr.nbytes):
             if self.world_size == 1:
                 return arr
             if self.rank == 0:
@@ -238,7 +245,7 @@ class CPUGroup:
             return _recv_msg(self._hub)
 
     def barrier(self) -> None:
-        with _telemetry.timed_op("barrier", "cpu", self.world_size):
+        with self._gang_op("barrier"):
             self._allreduce(np.zeros(1, dtype=np.int8), ReduceOp.SUM)
 
     # ------------------------------------------------------------- ops (p2p)

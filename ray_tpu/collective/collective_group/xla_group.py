@@ -83,6 +83,15 @@ class XLAGroup:
 
         self._jax = jax
         self.devices = jax.devices()  # global across member processes
+        # Gang-op sequence for the collective-entry watchdog (same
+        # SPMD lockstep contract as the cpu backend).
+        self._gang_seq = 0
+
+    def _gang_op(self, op: str, nbytes: int = 0):
+        self._gang_seq += 1
+        return _telemetry.timed_op(op, "xla", self.world_size, nbytes,
+                                   group_name=self.group_name,
+                                   rank=self.rank, seq=self._gang_seq)
 
     # ------------------------------------------------------------ in-graph
     def global_mesh(self, axis_name: str = "x"):
@@ -120,20 +129,17 @@ class XLAGroup:
 
     def allreduce(self, array, op: ReduceOp = ReduceOp.SUM):
         arr = np.asarray(array)
-        with _telemetry.timed_op("allreduce", "xla", self.world_size,
-                                 arr.nbytes):
+        with self._gang_op("allreduce", arr.nbytes):
             return self._allreduce(arr, op)
 
     def allgather(self, array) -> List[np.ndarray]:
         arr = np.asarray(array)
-        with _telemetry.timed_op("allgather", "xla", self.world_size,
-                                 arr.nbytes):
+        with self._gang_op("allgather", arr.nbytes):
             return self._gather_all(arr)
 
     def reducescatter(self, array, op: ReduceOp = ReduceOp.SUM):
         arr = np.asarray(array)
-        with _telemetry.timed_op("reducescatter", "xla",
-                                 self.world_size, arr.nbytes):
+        with self._gang_op("reducescatter", arr.nbytes):
             total = self._allreduce(arr, op)
             return np.array_split(total, self.world_size,
                                   axis=0)[self.rank]
@@ -142,15 +148,14 @@ class XLAGroup:
         from jax.experimental import multihost_utils
 
         arr = np.asarray(array)
-        with _telemetry.timed_op("broadcast", "xla", self.world_size,
-                                 arr.nbytes):
+        with self._gang_op("broadcast", arr.nbytes):
             return np.asarray(multihost_utils.broadcast_one_to_all(
                 arr, is_source=self.rank == src_rank))
 
     def barrier(self) -> None:
         from jax.experimental import multihost_utils
 
-        with _telemetry.timed_op("barrier", "xla", self.world_size):
+        with self._gang_op("barrier"):
             multihost_utils.sync_global_devices(
                 f"rt_barrier_{self.group_name}")
 
@@ -161,7 +166,10 @@ class XLAGroup:
             "cpu backend for host p2p")
 
     def recv(self, src_rank: int, timeout: float = 120.0):
-        self.send(None, src_rank)
+        raise NotImplementedError(
+            "point-to-point on the XLA backend is expressed in-graph via "
+            "ppermute over a mesh axis (see ray_tpu.parallel); use the "
+            "cpu backend for host p2p")
 
     def destroy(self) -> None:
         pass  # the jax world outlives groups by design

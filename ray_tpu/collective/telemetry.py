@@ -17,8 +17,10 @@ which collective a dead worker was in.
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
 
 # Latency boundaries tuned for collectives: 100µs .. 30s.
 _BOUNDS = (1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
@@ -33,6 +35,44 @@ _BUSBW_FACTOR = {
     "send": lambda n: 1.0,
     "recv": lambda n: 1.0,
 }
+
+
+# ---------------------------------------------------- gang watchdog
+# Entry stamps for the collective-entry watchdog: each rank stamps
+# "I am inside op #seq of group G" on entry and clears it on exit.
+# The worker flush loop ships the CURRENT inflight set to the
+# controller every tick, which merges stamps across ranks; `rt
+# doctor` flags gangs where some ranks are absent past the
+# collective_watchdog_s deadline — naming the op AND the missing
+# ranks, the diagnosis that previously required reading every rank's
+# log by hand.
+_inflight_lock = threading.Lock()
+_inflight: Dict[Tuple[str, int], Dict[str, Any]] = {}
+
+
+def _stamp_entry(op: str, backend: str, world_size: int,
+                 group_name: str, rank: int, seq: int) -> None:
+    with _inflight_lock:
+        _inflight[(group_name, seq)] = {
+            "group": group_name, "seq": int(seq), "op": op,
+            "backend": backend, "world": int(world_size),
+            "rank": int(rank), "since": time.time()}
+
+
+def _stamp_exit(group_name: str, seq: int) -> None:
+    with _inflight_lock:
+        _inflight.pop((group_name, seq), None)
+
+
+def inflight_entries() -> List[Dict[str, Any]]:
+    """Snapshot of collectives this process is currently inside.
+    Each entry carries ``age_s`` (a same-clock delta) so the
+    controller can rebase the entry time onto ITS clock — absolute
+    worker-host timestamps are not comparable across hosts."""
+    now = time.time()
+    with _inflight_lock:
+        return [{**v, "age_s": max(now - v["since"], 0.0)}
+                for v in _inflight.values()]
 
 
 def record_op(op: str, backend: str, world_size: int, nbytes: int,
@@ -82,7 +122,9 @@ def _record_span(op: str, backend: str, world_size: int,
 
 
 @contextmanager
-def timed_op(op: str, backend: str, world_size: int, nbytes: int = 0):
+def timed_op(op: str, backend: str, world_size: int, nbytes: int = 0,
+             *, group_name: Optional[str] = None,
+             rank: Optional[int] = None, seq: Optional[int] = None):
     # Flight-record the START too: a worker preempted mid-collective
     # must show WHICH op it was blocked in — completion-only records
     # would miss exactly the hung/preempted case postmortems exist for.
@@ -94,6 +136,10 @@ def timed_op(op: str, backend: str, world_size: int, nbytes: int = 0):
                                bytes=nbytes)
     except Exception:
         flight_recorder = None
+    stamped = group_name is not None and rank is not None \
+        and seq is not None
+    if stamped:
+        _stamp_entry(op, backend, world_size, group_name, rank, seq)
     t0 = time.perf_counter()
     t0_wall = time.time()
     try:
@@ -105,6 +151,9 @@ def timed_op(op: str, backend: str, world_size: int, nbytes: int = 0):
                 seconds=round(time.perf_counter() - t0, 6))
         _record_span(op, backend, world_size, t0_wall, error=repr(e))
         raise
+    finally:
+        if stamped:
+            _stamp_exit(group_name, seq)
     record_op(op, backend, world_size, nbytes,
               time.perf_counter() - t0)
     _record_span(op, backend, world_size, t0_wall)

@@ -118,7 +118,7 @@ class _PooledLease:
     can never deadlock behind a blocked task on the same worker."""
 
     __slots__ = ("lease_id", "agent_addr", "worker_addr", "worker_id",
-                 "chip_ids", "idle_since", "dead")
+                 "chip_ids", "idle_since", "dead", "inflight")
 
     def __init__(self, lease_id, agent_addr, worker_addr, worker_id,
                  chip_ids):
@@ -129,6 +129,9 @@ class _PooledLease:
         self.chip_ids = chip_ids
         self.idle_since = 0.0
         self.dead = False
+        # Pushes currently in flight on this lease (reported to the
+        # agent so `rt list leases` can show pipeline depth).
+        self.inflight = 0
 
 
 class _SchedKeyState:
@@ -235,6 +238,19 @@ class ClusterRuntime(BaseRuntime):
         self._reply_waiters: Dict[int, tuple] = {}
         self._shutdown_flag = False
         self._event_cursor = 0
+        # Owner-side scheduling-transition events (queued ->
+        # lease_requested -> pipelined/granted -> requeued), flushed
+        # to the controller's task-event sink so `rt explain` can show
+        # WHY a task landed where it did.  Buffer is bounded; a
+        # submission storm drops oldest explainability events rather
+        # than growing without limit.
+        self._sched_ev_buf: List[Dict] = []
+        self._sched_ev_lock = threading.Lock()
+        self._sched_ev_dropped = 0
+        self._sched_flusher_started = False
+        # Actor replies awaiting redelivery across an owner reconnect
+        # (reply_id set; guards double-spawn on repeated disconnects).
+        self._redelivering: Set[int] = set()
         # Worker-role: current lease for blocked-CPU accounting.
         self.current_lease_id: Optional[int] = None
         self.io.run(self._async_init())
@@ -528,6 +544,61 @@ class ClusterRuntime(BaseRuntime):
         notify stream items back to it."""
         return f"owner-{self._runtime_id}"
 
+    # ----------------------------------------- scheduler explainability
+    def _sched_event(self, spec: TaskSpec, state: str,
+                     **detail) -> None:
+        """Record one owner-side scheduling transition with reason
+        tags (ref: the task-state machine in gcs_task_manager — here
+        extended with the owner's lease-pool decisions, which the
+        reference leaves invisible).  Any thread; never raises."""
+        try:
+            ev = {"task_id": spec.task_id.hex(), "state": state,
+                  "ts": time.time(), "name": spec.display_name(),
+                  "kind": spec.kind.name,
+                  "attempt": getattr(spec, "sched_attempt", 0)}
+            if detail:
+                ev["detail"] = {k: v for k, v in detail.items()
+                                if v is not None}
+            with self._sched_ev_lock:
+                self._sched_ev_buf.append(ev)
+                if len(self._sched_ev_buf) > 10000:
+                    # Counted, not silent: the drop tally rides the
+                    # next flush into the controller's
+                    # task_events_dropped so a gapped `rt explain`
+                    # chain is attributable to backpressure.
+                    self._sched_ev_dropped += 5000
+                    del self._sched_ev_buf[:5000]
+                start = not self._sched_flusher_started
+                if start:
+                    self._sched_flusher_started = True
+            if start:
+                from .rpc import spawn_task
+
+                self.io.call_soon(
+                    lambda: spawn_task(self._sched_event_flush_loop(),
+                                       self.io.loop))
+        except Exception:
+            pass
+
+    async def _sched_event_flush_loop(self) -> None:
+        while not self._shutdown_flag:
+            await asyncio.sleep(0.5)
+            with self._sched_ev_lock:
+                batch, self._sched_ev_buf = self._sched_ev_buf, []
+                dropped, self._sched_ev_dropped = \
+                    self._sched_ev_dropped, 0
+            if not batch and not dropped:
+                continue
+            try:
+                await self._ctl.call("task_events", {
+                    "events": batch, "dropped": dropped})
+            except (RpcError, RemoteCallError,
+                    asyncio.CancelledError):
+                # Explainability is best-effort, but keep the drop
+                # tally for the next successful flush.
+                with self._sched_ev_lock:
+                    self._sched_ev_dropped += dropped
+
     async def _worker_client(self, addr: str) -> RpcClient:
         cli = self._worker_clients.get(addr)
         if cli is None or not cli.connected:
@@ -722,6 +793,10 @@ class ClusterRuntime(BaseRuntime):
             self._streams[spec.task_id.hex()] = _StreamState()
         oids = spec.return_object_ids()
         self._mark_pending(oids)
+        self._sched_event(spec, "QUEUED",
+                          strategy=spec.scheduling.kind,
+                          resources=dict(spec.resources.amounts),
+                          poolable=self._poolable(spec))
         held = [a.object_id for a in spec.args
                 if a.kind == ArgKind.OBJECT_REF and a.object_id is not None]
         self._add_submitted_holds(held)
@@ -813,6 +888,7 @@ class ClusterRuntime(BaseRuntime):
                 if attempts_left != 0:
                     if attempts_left > 0:
                         attempts_left -= 1
+                    spec.sched_attempt += 1
                     await asyncio.sleep(delay)
                     delay = min(delay * 2, 2.0)
                     continue
@@ -826,6 +902,9 @@ class ClusterRuntime(BaseRuntime):
                 if getattr(result, "requeue", False):
                     # Direct-path push landed on a worker whose running
                     # task is blocked: resubmit through a fresh lease.
+                    self._sched_event(spec, "REQUEUED",
+                                      worker=sub.worker_addr,
+                                      reason="worker_blocked")
                     await asyncio.sleep(0.01)
                     continue
                 err = result.error
@@ -841,11 +920,13 @@ class ClusterRuntime(BaseRuntime):
                     # consuming the user's retry budget (ref:
                     # task_manager.cc resubmit on OBJECT_UNRECONSTRUCTABLE
                     # is owner-driven, not a task failure).
+                    spec.sched_attempt += 1
                     continue
                 if spec.retry_exceptions and attempts_left != 0 \
                         and not sub.cancelled:
                     if attempts_left > 0:
                         attempts_left -= 1
+                    spec.sched_attempt += 1
                     await asyncio.sleep(delay)
                     continue
                 self._fail_returns(spec, err if isinstance(err, TaskError)
@@ -1101,7 +1182,8 @@ class ClusterRuntime(BaseRuntime):
                 batch.append(nxt)
             if batch:
                 inflight.update(await self._exec_batch_send(
-                    st, pl, batch))
+                    st, pl, batch, len(inflight)))
+            pl.inflight = len(inflight)
             if not inflight:
                 if pl.dead:
                     self._pump_key(st)
@@ -1140,7 +1222,8 @@ class ClusterRuntime(BaseRuntime):
                 stall_round = 0
 
     async def _exec_batch_send(self, st: _SchedKeyState,
-                               pl: _PooledLease, items) -> list:
+                               pl: _PooledLease, items,
+                               inflight_before: int = 0) -> list:
         """Ship a batch of tasks to a leased worker as ONE notify
         frame; per-item results come back batched as task_results
         notifies (ref: the push/report split in core_worker.proto —
@@ -1150,13 +1233,20 @@ class ClusterRuntime(BaseRuntime):
         loop = asyncio.get_event_loop()
         rfuts = []
         payload_tasks = []
-        for item in items:
+        for pos, item in enumerate(items):
             spec, sub, fut, _t = item
             rid = next(self._reply_counter)
             sub.agent_addr = pl.agent_addr
             sub.worker_addr = pl.worker_addr
             sub.worker_id = pl.worker_id
             sub.pushed = True
+            depth = inflight_before + pos
+            self._sched_event(
+                spec, "PIPELINED", lease_id=pl.lease_id,
+                agent=pl.agent_addr, worker=pl.worker_addr,
+                depth=depth,
+                reason=("idle_lease" if depth == 0
+                        else "pipelined_behind_busy_lease"))
             if spec.is_streaming:
                 stream = self._streams.get(spec.task_id.hex())
                 if stream is not None:
@@ -1191,6 +1281,10 @@ class ClusterRuntime(BaseRuntime):
             if getattr(res, "requeue", False):
                 # The worker's running task blocked in get(): fail
                 # over to another lease, keeping rough order.
+                self._sched_event(spec, "REQUEUED",
+                                  lease_id=pl.lease_id,
+                                  worker=pl.worker_addr,
+                                  reason="worker_blocked")
                 st.queue.appendleft(item)
                 sub.pushed = False
                 self._pump_key(st)
@@ -1210,11 +1304,27 @@ class ClusterRuntime(BaseRuntime):
         to_pump = {}
         for rid, ent in list(self._reply_waiters.items()):
             if ent[0] == "actor":
+                # Don't fail the call outright: the reply frame may
+                # have been LOST in a connection reregistration race
+                # (the worker re-buffers undeliverable replies).
+                # Re-dial — which re-registers our tag and triggers
+                # the worker's redelivery — and only fail once the
+                # grace expires (the PROGRESS reply-loss flake).
                 _kind, afut, a_addr = ent
-                if a_addr == addr:
+                if a_addr != addr:
+                    continue
+                if afut.done():
+                    # Already resolved (e.g. caller-side cancel)
+                    # with the entry still parked: no reply frame
+                    # will ever pop it now that the worker is gone,
+                    # so drop it here or it leaks forever.
                     self._reply_waiters.pop(rid, None)
-                    if not afut.done():
-                        afut.set_exception(err)
+                elif rid not in self._redelivering:
+                    self._redelivering.add(rid)
+                    from .rpc import spawn_task
+
+                    spawn_task(self._await_reply_redelivery(
+                        rid, afut, addr))
                 continue
             _kind, rfut, st, pl, item = ent
             if pl.worker_addr != addr:
@@ -1232,6 +1342,45 @@ class ClusterRuntime(BaseRuntime):
             to_pump[id(st)] = st
         for st in to_pump.values():
             self._pump_key(st)
+
+    async def _await_reply_redelivery(self, rid: int, afut, addr: str
+                                      ) -> None:
+        """An actor-call reply's connection died with the call in
+        flight.  Reconnect (re-registering the caller tag, which is
+        the worker's redelivery trigger) and give the re-buffered
+        reply a grace window to arrive before declaring the call
+        lost.  A worker that is actually dead fails the re-dial, so
+        real death still surfaces promptly."""
+        grace = self.config.reply_redelivery_grace_s
+        try:
+            try:
+                await self._worker_client(addr)
+            except Exception:  # noqa: BLE001 — worker truly gone
+                self._reply_waiters.pop(rid, None)
+                if not afut.done():
+                    afut.set_exception(RpcError(
+                        f"connection to {addr} lost"))
+                return
+            try:
+                await asyncio.wait_for(asyncio.shield(afut), grace)
+                return  # redelivered (or resolved elsewhere)
+            except asyncio.TimeoutError:
+                pass
+            except asyncio.CancelledError:
+                # Either afut was cancelled caller-side or this task
+                # is being torn down — in both cases the waiter entry
+                # is dead and nothing else will remove it.
+                self._reply_waiters.pop(rid, None)
+                raise
+            except Exception:  # noqa: BLE001 — resolved with error
+                return
+            self._reply_waiters.pop(rid, None)
+            if not afut.done():
+                afut.set_exception(RpcError(
+                    f"connection to {addr} lost (reply not "
+                    f"redelivered within {grace:.0f}s)"))
+        finally:
+            self._redelivering.discard(rid)
 
     async def _request_pool_lease(self, st: _SchedKeyState,
                                   rid: str) -> None:
@@ -1329,10 +1478,14 @@ class ClusterRuntime(BaseRuntime):
         periodic report per scheduling key, NOT a field frozen into a
         queued lease request for up to an hour)."""
         last_backlog: Dict[tuple, int] = {}
+        last_pool_report = 0.0
         while not self._shutdown_flag:
             await asyncio.sleep(0.1)
             now = asyncio.get_event_loop().time()
             ttl = self.config.lease_keepalive_s
+            if now - last_pool_report >= 0.45:
+                last_pool_report = now
+                await self._report_lease_pools()
             for key, st in list(self._sched_states.items()):
                 if st.queue:
                     # Re-pump: items past the request grace get their
@@ -1366,6 +1519,41 @@ class ClusterRuntime(BaseRuntime):
                         and not st.request_agents:
                     self._sched_states.pop(key, None)
                     last_backlog.pop(key, None)
+
+    async def _report_lease_pools(self) -> None:
+        """Ship this owner's pooled-lease pipeline depths to the
+        granting agents (sweeper cadence) so the agent's lease ledger
+        — `rt list leases` — shows how deep each held lease is
+        pipelined, owner-side state the agent cannot observe."""
+        by_agent: Dict[str, Dict[int, int]] = {}
+        for st in self._sched_states.values():
+            for pl in st.leases.values():
+                if not pl.dead:
+                    by_agent.setdefault(pl.agent_addr, {})[
+                        pl.lease_id] = pl.inflight
+        for addr, leases in by_agent.items():
+            # Never DIAL for this: the report rides the sweep loop,
+            # and a blackholed peer agent would block every sweep
+            # duty (re-pump, idle returns, backlog reports) for the
+            # whole connect timeout.  Only already-connected clients
+            # get the notify; a lease implies one normally exists.
+            if addr == self.agent_addr:
+                agent = self._agent
+            else:
+                agent = getattr(self, "_peer_agent_clients",
+                                {}).get(addr)
+            if agent is None or not agent.connected:
+                continue
+            try:
+                # notify_nowait, not notify: notify() awaits drain(),
+                # and a peer that is connected but not reading would
+                # park the sweep loop on transport backpressure — the
+                # same every-sweep-duty stall the no-DIAL rule above
+                # exists to prevent, just one layer down.
+                agent.notify_nowait("report_lease_pool", {
+                    "owner": self._runtime_id, "leases": leases})
+            except (RpcError, RemoteCallError, OSError):
+                pass
 
     def _cancel_lease_request_async(self, rid: str,
                                     agent_addr: str) -> None:
@@ -1414,6 +1602,11 @@ class ClusterRuntime(BaseRuntime):
             sub.agent_addr = agent_addr
             agent = await self._agent_for(agent_addr)
             payload["owner_tag"] = self._owner_tag_for(agent_addr)
+            self._sched_event(spec, "LEASE_REQUESTED",
+                              agent=agent_addr, hops=hops,
+                              strategy=spec.scheduling.kind,
+                              reason=("spillback_redirect" if hops
+                                      else "local_agent"))
             logger.debug("lease req %s -> %s (hops=%d)",
                          spec.display_name(), agent_addr, hops)
             grant = await agent.call("request_lease", payload)
@@ -1440,6 +1633,13 @@ class ClusterRuntime(BaseRuntime):
             raise RemoteCallError(ValueError(
                 grant.get("error", "lease request failed")))
         lease_id = grant["lease_id"]
+        node_id = grant.get("node_id")
+        self._sched_event(spec, "GRANTED", lease_id=lease_id,
+                          agent=agent_addr,
+                          node=(node_id.hex() if hasattr(node_id,
+                                                         "hex")
+                                else node_id),
+                          worker=grant["worker_addr"], hops=hops)
         sub.worker_addr = grant["worker_addr"]
         sub.worker_id = grant.get("worker_id")
         sub.pushed = True

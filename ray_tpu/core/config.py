@@ -159,6 +159,42 @@ define_flag("lease_request_limit", int, 10,
             "key (resource shape + runtime env) per owner (ref: "
             "StaticLeaseRequestRateLimiter in "
             "normal_task_submitter.h).")
+define_flag("streaming_max_pending", int, 0,
+            "Executor-side backpressure window for streaming "
+            "generators: max unconsumed items before the producer "
+            "pauses (0 = unbounded, matching the reference default). "
+            "A bounded pause is treated as a blocked state, so tasks "
+            "pipelined behind the paused producer requeue to another "
+            "worker instead of stalling forever.")
+define_flag("result_redelivery_timeout_s", float, 30.0,
+            "How long a worker retains task/stream results it could "
+            "not deliver (owner connection mid-reregistration), "
+            "retrying whenever the owner's tag re-registers, before "
+            "dropping them.")
+define_flag("reply_redelivery_grace_s", float, 10.0,
+            "Owner-side wait for a redelivered actor-call reply after "
+            "the worker connection dropped: the owner re-dials (which "
+            "re-registers its tag, triggering the worker's "
+            "redelivery) and only fails the call once this grace "
+            "expires.")
+define_flag("collective_watchdog_s", float, 30.0,
+            "Gang watchdog deadline: a collective some ranks entered "
+            "but others have not joined within this window is flagged "
+            "hung by `rt doctor` (names the op and the missing "
+            "ranks).")
+define_flag("stuck_task_min_s", float, 60.0,
+            "Stuck-task detector floor: a RUNNING task is never "
+            "flagged before this age, and a task stuck in owner-side "
+            "scheduling (queued/lease-requested with no progress) is "
+            "flagged after it.")
+define_flag("stuck_task_p99_factor", float, 3.0,
+            "Stuck-task detector multiplier: a RUNNING task is "
+            "flagged once its age exceeds factor x the historical p99 "
+            "duration of same-named finished tasks (and the floor).")
+define_flag("straggler_threshold", float, 0.2,
+            "Straggler detector: a rank whose step time exceeds the "
+            "per-step median by this fraction, sustained over the "
+            "sliding window of recent steps, is flagged.")
 # TPU-specific flags.
 define_flag("tpu_chips_per_host", int, 0,
             "Override detected TPU chip count (0 = autodetect).")

@@ -38,6 +38,13 @@ ALIVE = "ALIVE"
 RESTARTING = "RESTARTING"
 DEAD = "DEAD"
 
+# Task-state lifecycle tiers for headline-state resolution: terminal
+# execution states outrank RUNNING, which outranks every owner-side
+# scheduling state (QUEUED/LEASE_REQUESTED/PIPELINED/GRANTED/REQUEUED,
+# all tier 1).  Owner and worker clocks are different hosts, so tiers
+# — not timestamps — decide across the two planes.
+_STATE_TIER = {"FINISHED": 3, "FAILED": 3, "RUNNING": 2}
+
 
 @dataclass
 class NodeEntry:
@@ -113,6 +120,14 @@ class Controller:
         # On-demand profiler artifacts (e.g. jax.profiler trace dirs)
         # reported by node agents after an `rt profile --jax` capture.
         self.profile_artifacts: "_deque[Dict]" = _deque(maxlen=64)
+        # Gang-watchdog input: per-source inflight collective-entry
+        # stamps, REPLACED on every report (an exited op vanishes on
+        # the reporter's next tick; a hung one keeps refreshing).
+        self.collective_reports: Dict[str, Dict] = {}
+        # Autoscaler decision ring: one bounded record per reconcile
+        # tick that acted or found unsatisfiable demand — the "why
+        # didn't it scale" answer (round-5 demand-blindness weakness).
+        self.autoscaler_decisions: "_deque[Dict]" = _deque(maxlen=128)
         self._agent_clients: Dict[NodeID, RpcClient] = {}
         self._placement = None  # PlacementGroupManager, attached in setup
         self._shutdown = asyncio.Event()
@@ -134,6 +149,8 @@ class Controller:
             "metrics_history", "get_load_metrics", "worker_logs",
             "telemetry", "report_flight_dump",
             "report_spans", "list_spans", "report_profile",
+            "explain_task", "collective_entries",
+            "report_autoscaler_decision", "doctor_feed",
         ]:
             self.server.register(name, getattr(self, name))
 
@@ -695,6 +712,11 @@ class Controller:
         """Batched task state transitions from workers (ref:
         task_event_buffer.h:222 flush -> gcs_task_manager.h:86)."""
         cap = max(self.config.task_event_buffer_size, 16)
+        recv_ts = time.time()
+        # Owner-side explainability events trimmed before they could
+        # flush count as drops too — a gapped `rt explain` chain must
+        # be attributable to backpressure, not read as a phantom bug.
+        self.task_events_dropped += int(p.get("dropped") or 0)
         for ev in p["events"]:
             tid = ev["task_id"]
             rec = self.task_records.get(tid)
@@ -711,11 +733,54 @@ class Controller:
                 rec = self.task_records[tid] = {
                     "task_id": tid, "times": {}}
             rec.update({k: v for k, v in ev.items()
-                        if k not in ("task_id", "state", "ts")})
+                        if k not in ("task_id", "state", "ts",
+                                     "detail", "attempt")})
             state = ev.get("state")
             if state:
-                rec["state"] = state
-                rec["times"][state] = ev["ts"]
+                # Owner-side scheduling events (QUEUED/PIPELINED/...)
+                # and worker-side execution events flush on different
+                # cadences AND carry timestamps from different hosts,
+                # so neither arrival order nor raw timestamps resolve
+                # the headline state.  Rank by execution attempt
+                # first (a retry's events supersede the previous
+                # attempt's terminal state), then lifecycle tier
+                # (terminal > running > scheduling); timestamps only
+                # break ties within the same attempt and tier.
+                cur = rec.get("state")
+                cur_att = int(rec.get("attempt") or 0)
+                new_att = int(ev.get("attempt") or 0)
+                cur_tier = _STATE_TIER.get(cur, 1)
+                new_tier = _STATE_TIER.get(state, 1)
+                if cur is None or new_att > cur_att or (
+                        new_att == cur_att
+                        and (new_tier > cur_tier
+                             or (new_tier == cur_tier
+                                 and ev["ts"] >= rec["times"].get(
+                                     cur, float("-inf"))))):
+                    rec["state"] = state
+                    rec["attempt"] = max(cur_att, new_att)
+                if new_att >= cur_att:
+                    # A late batch from a PREVIOUS attempt must not
+                    # roll timestamps back under the current one.
+                    rec["times"][state] = ev["ts"]
+                    # Receipt-clock shadow: reporter timestamps come
+                    # from arbitrary host clocks, so age computations
+                    # (the stuck-task detector) use the controller's
+                    # receipt time; durations still use the
+                    # reporter-clock times (same-host deltas).
+                    rec.setdefault("times_recv", {})[state] = recv_ts
+                # Full transition chain with reason tags (scheduler
+                # explainability: queued -> lease_requested ->
+                # pipelined/granted -> running -> finished/requeued),
+                # bounded per task so a retry storm can't grow a
+                # record without limit.
+                chain = rec.setdefault("transitions", [])
+                detail = dict(ev.get("detail") or {})
+                if new_att:
+                    detail["attempt"] = new_att
+                chain.append([ev["ts"], state, detail])
+                if len(chain) > 64:
+                    del chain[:len(chain) - 64]
         self._mark_dirty()
         return {"ok": True}
 
@@ -737,6 +802,106 @@ class Controller:
 
     async def get_task(self, p):
         return self.task_records.get(p["task_id"])
+
+    async def explain_task(self, p):
+        """Scheduler explainability: the full transition chain of one
+        task (`rt explain <task_id>`; prefix match accepted).  Answers
+        *why* a task sat where it did — which lease it pipelined onto,
+        which agent queued its lease request, whether it was requeued
+        off a blocked worker — without reading agent logs."""
+        tid = p.get("task_id") or ""
+        rec = self.task_records.get(tid)
+        if rec is None and tid:
+            matches = [r for t, r in self.task_records.items()
+                       if t.startswith(tid)]
+            if len(matches) == 1:
+                rec = matches[0]
+            elif len(matches) > 1:
+                return {"ok": False,
+                        "error": f"task id prefix {tid!r} is ambiguous "
+                                 f"({len(matches)} matches)"}
+        if rec is None:
+            return {"ok": False, "error": f"no task record {tid!r} "
+                                          f"(dropped or never seen)"}
+        return {"ok": True, "task": rec}
+
+    # ------------------------------------------------- health plane
+    async def collective_entries(self, p):
+        """Per-source inflight collective stamps (gang watchdog).
+        Replace semantics: each report is the source's CURRENT set."""
+        src = p.get("source") or "?"
+        now = time.time()
+        # Rebase entry times onto the CONTROLLER clock from the
+        # reporter's age delta: worker-host wall clocks can be
+        # arbitrarily skewed, and the watchdog deadline is small
+        # enough that skew alone would forge (or mask) a hang.
+        entries = []
+        for e in p.get("entries") or []:
+            if "age_s" in e:
+                e = {**e, "since": now - float(e["age_s"])}
+            entries.append(e)
+        self.collective_reports[src] = {"ts": now, "entries": entries}
+        # Prune dead reporters here too, not just in the doctor-feed
+        # merge: under worker churn on a cluster nobody runs `rt
+        # doctor` against, the per-source dict would otherwise grow
+        # one entry per dead worker forever.
+        self._prune_collective_reports(now)
+        return {"ok": True}
+
+    def _collective_horizon(self) -> float:
+        return max(self.config.metrics_report_period_s * 3, 5.0)
+
+    def _prune_collective_reports(self, now: float) -> None:
+        horizon = self._collective_horizon()
+        for src in [s for s, v in list(self.collective_reports.items())
+                    if now - v["ts"] > horizon * 4]:
+            del self.collective_reports[src]  # dead reporter
+
+    def _merged_collective_inflight(self, now: float) -> List[Dict]:
+        """Merge fresh per-source stamps into one row per (group,
+        seq): which ranks are inside, since when, expecting how many."""
+        horizon = self._collective_horizon()
+        merged: Dict[Tuple[str, int], Dict] = {}
+        self._prune_collective_reports(now)
+        for src, rep in self.collective_reports.items():
+            if now - rep["ts"] > horizon:
+                continue  # stale: the process stopped refreshing
+            for e in rep["entries"]:
+                key = (e.get("group", "?"), int(e.get("seq", 0)))
+                rec = merged.get(key)
+                if rec is None:
+                    rec = merged[key] = {
+                        "group": key[0], "seq": key[1],
+                        "op": e.get("op", "?"),
+                        "backend": e.get("backend", "?"),
+                        "world": int(e.get("world", 0)),
+                        "ranks": {}}
+                rec["ranks"][int(e.get("rank", -1))] = \
+                    float(e.get("since", now))
+        return list(merged.values())
+
+    async def report_autoscaler_decision(self, p):
+        self.autoscaler_decisions.append({
+            "ts": p.get("ts") or time.time(),
+            "demands": p.get("demands", 0),
+            "launched": list(p.get("launched") or []),
+            "terminated": list(p.get("terminated") or []),
+            "unsatisfied": list(p.get("unsatisfied") or [])})
+        return {"ok": True}
+
+    async def doctor_feed(self, _p):
+        """One-stop raw feed for `rt doctor` / /api/doctor: the
+        health-plane state only the controller holds.  The client
+        (util/doctor.py) combines it with the regular state RPCs."""
+        now = time.time()
+        return {
+            "ts": now,
+            "collective_inflight": self._merged_collective_inflight(
+                now),
+            "autoscaler_decisions": list(self.autoscaler_decisions),
+            "flight": list(self.flight_dumps.values()),
+            "task_events_dropped": self.task_events_dropped,
+        }
 
     async def list_objects(self, p):
         out = []
@@ -799,7 +964,12 @@ class Controller:
         src = p.get("source") or "?"
         self.flight_dumps[src] = {
             "source": src, "reason": p.get("reason", ""),
-            "ts": p.get("ts"), "path": p.get("path", ""),
+            # Receipt-clock shadow (same discipline as task times):
+            # the dump's own ts is the DYING WORKER's wall clock, not
+            # comparable with the controller clock ages are computed
+            # against.
+            "ts": p.get("ts"), "ts_recv": time.time(),
+            "path": p.get("path", ""),
             "sticky": p.get("sticky") or {},
             "events": (p.get("events") or [])[-200:]}
         self.flight_dumps.move_to_end(src)

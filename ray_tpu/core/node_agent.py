@@ -75,6 +75,7 @@ class Lease:
     # caching a lease for reuse — instead of stranding the leased
     # worker and its resources forever.
     owner_tag: str = ""
+    granted_ts: float = 0.0
 
 
 @dataclass
@@ -144,13 +145,21 @@ class NodeAgent:
         self._peer_agents: Dict[str, RpcClient] = {}
         self._resource_view: Dict[Any, Dict] = {}
         self._draining = False
+        # Lease-ledger view state (`rt list leases` / `rt doctor`):
+        # owner-reported pipeline depth per lease, when an owner tag's
+        # connection was first seen lost, and per-lease disconnect
+        # anchors derived from it.
+        self._owner_lease_depths: Dict[int, tuple] = {}
+        self._owner_conn_lost_ts: Dict[str, float] = {}
+        self._owner_disc_since: Dict[int, float] = {}
         self._shutdown = asyncio.Event()
         self._spawned_procs: List[subprocess.Popen] = []
         for name in [
             "request_lease", "return_lease", "lease_status",
-            "cancel_lease_request",
+            "cancel_lease_request", "list_leases", "report_lease_pool",
             "register_worker", "worker_heartbeat",
             "report_task_events", "report_metrics", "report_spans",
+            "report_collective_entries",
             "jax_profile_workers",
             "task_blocked", "task_unblocked", "report_backlog",
             "register_object", "pull_object", "fetch_raw", "fetch_chunk",
@@ -269,10 +278,7 @@ class NodeAgent:
                 # so queued tasks beyond the in-flight requests arrive
                 # via report_backlog; ref: ReportWorkerBacklog in
                 # normal_task_submitter.h).
-                demands = [dict(req.payload["resources"])
-                           for req in self.pending][:100]
-                demands += self._backlog_demands()
-                demands += list(getattr(self, "_infeasible", []))[:100]
+                demands = self._demand_vector()
                 if self.pending:
                     # Self-healing dispatch tick: a request requeued
                     # after a failed worker acquire has no event left
@@ -553,6 +559,17 @@ class NodeAgent:
             backlogs[key] = (dict(p["resources"]),
                              int(p["backlog"]), time.time())
         return {"ok": True}
+
+    def _demand_vector(self):
+        """This node's current unsatisfied demand: queued lease
+        requests + owner-reported backlogs + autoscaler-held
+        infeasible demands (the vector the heartbeat advertises and
+        `rt list leases` exposes for diagnosis)."""
+        demands = [dict(req.payload["resources"])
+                   for req in self.pending][:100]
+        demands += self._backlog_demands()
+        demands += list(getattr(self, "_infeasible", []))[:100]
+        return demands
 
     def _backlog_demands(self, cap: int = 100):
         """Fresh owner backlogs as a demand list for the autoscaler."""
@@ -861,7 +878,7 @@ class NodeAgent:
             lease_id=next(self._lease_counter), resources=demand, worker=w,
             chip_ids=chip_ids, pg_id=payload.get("pg_id"),
             bundle_index=payload.get("bundle_index", -1),
-            owner_tag=owner_tag)
+            owner_tag=owner_tag, granted_ts=time.time())
         w.state = "actor" if payload.get("is_actor") else "leased"
         w.lease_id = lease.lease_id
         if payload.get("job_id"):
@@ -1058,6 +1075,14 @@ class NodeAgent:
         stranded workers would hold their resources forever."""
         if not tag:
             return
+        # Stamp the disconnect time: the lease ledger reports
+        # "owner disconnected for N seconds" from THIS moment, not
+        # from whenever `rt list leases` first happens to look.
+        lost_ts = self._owner_conn_lost_ts
+        lost_ts[tag] = time.time()
+        if len(lost_ts) > 1024:  # bound under owner churn
+            oldest = min(lost_ts, key=lost_ts.get)
+            lost_ts.pop(oldest, None)
         owns = any(l.owner_tag == tag for l in self.leases.values()) \
             or any(req.payload.get("owner_tag") == tag
                    for req in self.pending) \
@@ -1199,6 +1224,100 @@ class NodeAgent:
             return {"alive": False}
         return {"alive": lease.worker.state != "dead",
                 "worker_addr": lease.worker.addr}
+
+    # ------------------------------------------------ lease ledger view
+    async def report_lease_pool(self, p):
+        """Owner-side pooled-lease state (notify, sweeper cadence):
+        per-lease in-flight pipeline depth, so `rt list leases` can
+        show how deep each held lease is pipelined — state only the
+        owner knows (pushes go owner -> worker directly)."""
+        depths = self._owner_lease_depths
+        now = time.time()
+        owner = p.get("owner")
+        for lid, depth in (p.get("leases") or {}).items():
+            depths[int(lid)] = (owner, int(depth), now)
+        # Prune on the report cadence, not just in list_leases (which
+        # only runs when an operator asks): returned leases stop
+        # refreshing and would otherwise accumulate forever.
+        self._prune_lease_depths(now)
+        return {"ok": True}
+
+    def _prune_lease_depths(self, now: float) -> None:
+        depths = self._owner_lease_depths
+        for lid in [k for k, (_o, _d, ts) in depths.items()
+                    if now - ts > 5.0]:
+            depths.pop(lid, None)
+
+    async def list_leases(self, _p):
+        """The node's lease ledger + demand vector (scheduler
+        explainability: what is held, by whom, how deep, how stale —
+        the state that previously was only visible in agent logs)."""
+        now = time.time()
+        depths = self._owner_lease_depths
+        self._prune_lease_depths(now)
+        # Disconnect AGE per lease: seeded from the connection-lost
+        # hook's stamp, so one `rt doctor` run sees the true age — a
+        # momentary re-dial must not read as a dead owner, but an
+        # owner that died an hour ago must not read as fresh either.
+        disc_since = self._owner_disc_since
+        lost_ts = self._owner_conn_lost_ts
+        leases = []
+        for lease in self.leases.values():
+            w = lease.worker
+            connected = (not lease.owner_tag
+                         or self.server.has_peer(lease.owner_tag))
+            if connected:
+                disc_since.pop(lease.lease_id, None)
+                lost_ts.pop(lease.owner_tag, None)
+            else:
+                disc_since.setdefault(
+                    lease.lease_id,
+                    lost_ts.get(lease.owner_tag, now))
+            ent = {
+                "lease_id": lease.lease_id,
+                "owner_tag": lease.owner_tag,
+                "owner_connected": connected,
+                "owner_disconnected_s": (
+                    now - disc_since[lease.lease_id]
+                    if not connected else 0.0),
+                "worker_pid": w.pid,
+                "worker_state": w.state,
+                "resources": dict(lease.resources.amounts),
+                "chip_ids": list(lease.chip_ids),
+                "blocked": lease.blocked,
+                "pg_id": (lease.pg_id.hex()
+                          if lease.pg_id is not None else None),
+                "bundle_index": lease.bundle_index,
+                "age_s": (now - lease.granted_ts
+                          if lease.granted_ts else 0.0),
+            }
+            dep = depths.get(lease.lease_id)
+            if dep is not None:
+                ent["pipeline_depth"] = dep[1]
+            leases.append(ent)
+        for lid in [k for k in disc_since if k not in self.leases]:
+            disc_since.pop(lid, None)  # lease returned/reclaimed
+        pending = [{"resources": dict(req.payload["resources"]),
+                    "strategy": req.payload.get("strategy", "DEFAULT"),
+                    "owner_tag": req.payload.get("owner_tag", ""),
+                    "age_s": now - req.enqueue_time}
+                   for req in self.pending]
+        return {"node_id": self.node_id.hex(),
+                "leases": leases, "pending": pending,
+                "demand": self._demand_vector(),
+                "available": dict(self.available.amounts),
+                "total": dict(self.total.amounts)}
+
+    async def report_collective_entries(self, p):
+        """Relay a worker's inflight collective-entry stamps to the
+        controller (gang watchdog input; same relay report_spans
+        rides)."""
+        p.setdefault("node_id", self.node_id.hex())
+        try:
+            await self._ctl.call("collective_entries", p)
+        except RpcError:
+            pass
+        return {"ok": True}
 
     # -------------------------------------------- blocked-worker CPU credit
     @staticmethod

@@ -66,6 +66,11 @@ class TaskSpec:
     resources: ResourceSet = field(default_factory=ResourceSet)
     max_retries: int = 0
     retry_exceptions: bool = False
+    # Execution attempt number, bumped by the owner's retry loop and
+    # carried in task events so a retry's RUNNING can supersede the
+    # previous attempt's FAILED headline state regardless of which
+    # host's clock stamped which event.
+    sched_attempt: int = 0
     name: str = ""
     scheduling: SchedulingStrategy = field(default_factory=SchedulingStrategy)
     runtime_env: Optional[Dict[str, Any]] = None

@@ -91,6 +91,19 @@ class Worker:
         # Batched-exec result buffer: caller_tag -> [(reply_id, res)].
         self._result_buf: Dict[str, list] = {}
         self._flush_scheduled = False
+        # Undeliverable peer notifies (owner connection mid-
+        # reregistration): per-tag ordered backlog, redelivered when
+        # the tag re-registers (the PROGRESS reply-loss flake: a
+        # final push_actor_task reply dropped when notify_peer raced a
+        # reconnect).  Loop-thread only; no lock needed.
+        self._undelivered: Dict[str, "_deque"] = {}
+        self._redelivery_task: Optional[asyncio.Task] = None
+        # Streams declared lost by a backlog overflow: their item
+        # frames are dropped and their final reply is poisoned.
+        # Insertion-ordered (dict) so the size bound evicts the
+        # OLDEST marks — an arbitrary eviction could drop a mark
+        # whose poisoned reply is still pending, un-poisoning it.
+        self._shed_streams: Dict[str, None] = {}
         for name in ["push_task", "exec_batch", "create_actor",
                      "push_actor_task", "exec_actor",
                      "cancel_task", "ping", "exit", "dump_stack",
@@ -124,7 +137,8 @@ class Worker:
         ev = {"task_id": spec.task_id.hex(), "state": state,
               "ts": time.time(), "name": spec.display_name(),
               "kind": spec.kind.name, "node_id": self.node_id_hex,
-              "worker_pid": os.getpid()}
+              "worker_pid": os.getpid(),
+              "attempt": getattr(spec, "sched_attempt", 0)}
         if spec.actor_id is not None:
             ev["actor_id"] = spec.actor_id.hex()
         ev.update(extra)
@@ -168,6 +182,21 @@ class Worker:
                         "source": source,
                         "node_id": self.node_id_hex,
                         "spans": span_batch})
+                # Gang watchdog: ship the set of collectives this
+                # process is CURRENTLY inside (replace semantics per
+                # source — an exited op vanishes on the next tick; a
+                # hung one keeps refreshing, which is exactly the
+                # signal the controller-side watchdog needs).  Only
+                # chatty while collectives are in flight.
+                from ray_tpu.collective import telemetry as _coll
+
+                entries = _coll.inflight_entries()
+                if entries or getattr(self, "_had_coll_entries",
+                                      False):
+                    self._had_coll_entries = bool(entries)
+                    await self._agent.call(
+                        "report_collective_entries", {
+                            "source": source, "entries": entries})
                 now = time.time()
                 if now - last_metrics >= period:
                     last_metrics = now
@@ -357,7 +386,10 @@ class Worker:
         caller = self._stream_callers.get(tid.hex())
         state = self._stream_acks.setdefault(
             tid.hex(), {"consumed": 0, "event": threading.Event()})
-        max_pending = 16
+        # 0 = unbounded (the reference default): a slow consumer must
+        # never wedge the producer — and with it every task pipelined
+        # behind this worker (the round-5 backpressure deadlock).
+        max_pending = self.config.streaming_max_pending
         loop = self._loop
         idx = 0
         transit: list = []
@@ -370,14 +402,30 @@ class Worker:
                            "object_id": oid, "entry": entry}
                 if caller is not None:
                     loop.call_soon_threadsafe(
-                        self.server.notify_peer, caller,
-                        "stream_item", payload)
-                # Backpressure: wait for the owner to consume within
-                # max_pending of what we've produced.  A cancelled
+                        self._send_peer, caller, "stream_item",
+                        payload)
+                # Backpressure (bounded windows only): wait for the
+                # owner to consume within max_pending of what we've
+                # produced.  The wait is a BLOCKED state — it releases
+                # the lease CPU and requeues tasks pipelined behind
+                # this worker (without that, a stalled consumer
+                # stalled every queued task forever).  A cancelled
                 # task unblocks via the async-raise in cancel_task.
-                while idx - state["consumed"] > max_pending:
-                    state["event"].clear()
-                    state["event"].wait(timeout=1.0)
+                if max_pending > 0 and \
+                        idx - state["consumed"] > max_pending:
+                    # Hysteresis: once blocked, stay blocked until the
+                    # backlog drains to HALF the window.  Waking per
+                    # consumed item would pay the blocked/unblocked
+                    # agent round-trip (and pipeline requeue churn)
+                    # for every streamed item once the consumer lags.
+                    resume_gap = max(1, max_pending // 2)
+                    self.runtime._notify_blocked(True)
+                    try:
+                        while idx - state["consumed"] > resume_gap:
+                            state["event"].clear()
+                            state["event"].wait(timeout=1.0)
+                    finally:
+                        self.runtime._notify_blocked(False)
             return TaskResult(task_id=tid, ok=True, returns=[],
                               transit_refs=transit, streamed=idx)
         except BaseException:
@@ -599,8 +647,157 @@ class Worker:
     def _flush_results(self) -> None:
         buf, self._result_buf = self._result_buf, {}
         for tag, entries in buf.items():
-            self.server.notify_peer(tag, "task_results",
-                                    {"results": entries})
+            self._send_peer(tag, "task_results", {"results": entries})
+
+    # ---- peer-notify redelivery (the reply-loss fix): a notify that
+    # ---- finds the peer's tag unregistered (its connection raced a
+    # ---- re-registration) is re-buffered IN ORDER and retried when
+    # ---- the tag re-registers, instead of being silently dropped —
+    # ---- a lost final reply left the owner waiting forever.
+    # Per-tag redelivery backlog cap: a fast unbounded streaming
+    # producer could otherwise grow worker RSS without limit over the
+    # whole redelivery window while its owner is disconnected.  On
+    # overflow the buffered STREAMS are declared lost (a partially
+    # redelivered stream with a missing index would hang the consumer
+    # at exhaustion — strictly worse than an error): their item
+    # frames are shed and their final reply is rewritten into a
+    # stream error the owner raises.  Non-stream replies are kept —
+    # they are the frames the redelivery buffer exists to save.
+    _UNDELIVERED_CAP = 4096
+
+    def _apply_shed(self, method, payload) -> bool:
+        """Apply the shed-stream contract to one frame: True means
+        the frame is a shed stream's item and must be dropped; a shed
+        stream's final reply is poisoned in place.  Every path that
+        emits or redelivers a frame must route through this."""
+        if not self._shed_streams:
+            return False
+        if method == "stream_item" and \
+                payload["task_id"].hex() in self._shed_streams:
+            return True
+        if method == "task_results":
+            self._poison_shed_results(payload)
+        return False
+
+    def _send_peer(self, tag: str, method: str, payload) -> None:
+        if self._apply_shed(method, payload):
+            return
+        q = self._undelivered.get(tag)
+        if q is not None:
+            # Preserve per-peer delivery order behind the backlog.
+            if len(q) >= self._UNDELIVERED_CAP:
+                self._shed_overflow(tag, q)
+                if self._apply_shed(method, payload):
+                    return
+            q.append((method, payload, time.time()))
+            return
+        if not self.server.notify_peer(tag, method, payload):
+            from collections import deque as _dq
+
+            self._undelivered[tag] = _dq([(method, payload,
+                                           time.time())])
+            self._ensure_redelivery()
+
+    def _shed_overflow(self, tag: str, q) -> None:
+        """Redelivery backlog overflow: shed every buffered stream's
+        item frames (marking the streams lost) and, failing that,
+        drop the oldest frame outright."""
+        shed = {f[1]["task_id"].hex() for f in q
+                if f[0] == "stream_item"}
+        if shed:
+            self._shed_streams.update(dict.fromkeys(shed))
+            while len(self._shed_streams) > 1024:  # bound, oldest out
+                self._shed_streams.pop(
+                    next(iter(self._shed_streams)), None)
+            kept = [f for f in q if f[0] != "stream_item"]
+            # Final replies already buffered for a just-shed stream
+            # are poisoned NOW (which also retires their marks): a
+            # mark must not sit live in the bound window waiting for
+            # a delivery pass that may evict it first.
+            for method, payload, _ts in kept:
+                if method == "task_results":
+                    self._poison_shed_results(payload)
+            logger.warning(
+                "redelivery backlog for %s overflowed; shed %d "
+                "buffered stream frame(s) — %d stream(s) to this "
+                "owner will fail instead of gapping", tag,
+                len(q) - len(kept), len(shed))
+            q.clear()
+            q.extend(kept)
+        if len(q) >= self._UNDELIVERED_CAP:
+            logger.warning(
+                "redelivery backlog for %s still full (%d); "
+                "dropping oldest undelivered frame", tag, len(q))
+            q.popleft()
+
+    def _poison_shed_results(self, payload) -> None:
+        """Rewrite a shed stream's final reply into an error: its
+        item frames are gone, so a successful streamed=N result
+        would leave the owner waiting for items that never come."""
+        for _rid, res in payload.get("results", []):
+            tid = getattr(res, "task_id", None)
+            if tid is not None and getattr(res, "streamed", 0) \
+                    and tid.hex() in self._shed_streams:
+                res.ok = False
+                res.error = TaskError.from_exception(RuntimeError(
+                    "stream items were dropped while the owner was "
+                    "disconnected (redelivery backlog overflow)"))
+                res.streamed = 0
+                self._shed_streams.pop(tid.hex(), None)
+
+    def _ensure_redelivery(self) -> None:
+        if self._redelivery_task is None or \
+                self._redelivery_task.done():
+            self._redelivery_task = spawn_task(self._redelivery_loop())
+
+    async def _redelivery_loop(self) -> None:
+        ttl = self.config.result_redelivery_timeout_s
+        while self._undelivered:
+            await asyncio.sleep(0.2)
+            now = time.time()
+            for tag in list(self._undelivered):
+                q = self._undelivered[tag]
+                while q and self.server.has_peer(tag):
+                    method, payload, _ts = q[0]
+                    # Frames buffered before a stream was shed (TTL
+                    # expiry below, or an overflow mid-backlog) must
+                    # get the same treatment _send_peer applies to
+                    # fresh ones: skip its items, poison its reply —
+                    # redelivering them would gap the stream.
+                    if self._apply_shed(method, payload):
+                        q.popleft()
+                        continue
+                    if not self.server.notify_peer(tag, method,
+                                                   payload):
+                        break
+                    q.popleft()
+                ttl_shed = False
+                while q and now - q[0][2] > ttl:
+                    method, payload, ts = q.popleft()
+                    if method == "stream_item":
+                        # Same contract as overflow shedding: once any
+                        # item frame is gone the stream can never be
+                        # redelivered whole, so its surviving frames
+                        # are dropped and its final reply poisoned
+                        # instead of handing the owner a gapped stream
+                        # with a successful result.
+                        self._shed_streams[
+                            payload["task_id"].hex()] = None
+                        ttl_shed = True
+                    logger.warning(
+                        "dropping undeliverable %s for %s after "
+                        "%.0fs (owner never re-registered)",
+                        method, tag, now - ts)
+                if ttl_shed:
+                    # Retire the new marks promptly where the final
+                    # reply is already buffered, as _shed_overflow
+                    # does — a live mark must not wait in the bound
+                    # window on a delivery pass that may never come.
+                    for method, payload, _ts in q:
+                        if method == "task_results":
+                            self._poison_shed_results(payload)
+                if not q:
+                    del self._undelivered[tag]
 
     def _on_exec_block(self, blocked: bool) -> None:
         """Runs on the TASK THREAD when the current task blocks in

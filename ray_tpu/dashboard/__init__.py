@@ -63,6 +63,7 @@ async function refresh() {
     `<a href="/timeseries">/timeseries</a> (utilization) · ` +
     `<a href="/api/telemetry?format=text">/api/telemetry</a> ` +
     `(goodput/MFU) · ` +
+    `<a href="/api/doctor?format=text">/api/doctor</a> (health) · ` +
     `<a href="/api/timeline">/api/timeline</a> (Perfetto trace)</p>`;
 }
 refresh(); setInterval(refresh, 3000);
@@ -130,6 +131,23 @@ def create_app(address: Optional[str] = None):
                 content_type="text/plain")
         return web.json_response(
             json.loads(json.dumps(summary, default=repr)))
+
+    async def doctor(req):
+        """/api/doctor — the aggregated health diagnosis (`rt doctor`
+        JSON): hung collectives (op + missing ranks), dead-owner
+        leases, never-idle nodes, infeasible placement groups, stuck
+        tasks, stragglers, autoscaler gaps, flight dumps.
+        ?format=text renders the CLI report."""
+        from ..util import doctor as doctor_mod
+
+        diag = await asyncio.get_event_loop().run_in_executor(
+            None,
+            lambda: doctor_mod.cluster_diagnosis(address=address))
+        if req.query.get("format") == "text":
+            return web.Response(text=doctor_mod.render_text(diag),
+                                content_type="text/plain")
+        return web.json_response(
+            json.loads(json.dumps(diag, default=repr)))
 
     async def timeline(req):
         """/api/timeline — the unified cluster timeline as Chrome-trace
@@ -257,6 +275,7 @@ def create_app(address: Optional[str] = None):
     app.router.add_get("/api/stack", stack)
     app.router.add_get("/api/profile", profile)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/api/doctor", doctor)
     app.router.add_get("/api/telemetry", telemetry)
     app.router.add_get("/api/timeline", timeline)
     app.router.add_get("/timeseries", timeseries)

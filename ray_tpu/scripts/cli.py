@@ -317,8 +317,28 @@ def cmd_list(args) -> int:
         "jobs": lambda: state_api.list_jobs(address=address),
         "placement-groups": lambda: state_api.list_placement_groups(
             address=address),
+        "leases": lambda: state_api.list_leases(address=address),
     }
     rows = fns[entity]()
+    if entity == "leases" and args.format != "json":
+        # Ledger -> one row per lease + a demand/pending summary line
+        # per node (the agent's view: owner, depth, idle age).
+        flat = []
+        for ledger in rows:
+            nid = str(ledger.get("node_id", "?"))[:12]
+            if ledger.get("error"):
+                print(f"{nid}: {ledger['error']}", file=sys.stderr)
+                continue
+            for lease in ledger.get("leases", []):
+                flat.append({"node": nid, **{
+                    k: v for k, v in lease.items()
+                    if not isinstance(v, (dict, list))}})
+            n_pend = len(ledger.get("pending", []))
+            n_dem = len(ledger.get("demand", []))
+            if n_pend or n_dem:
+                print(f"{nid}: {n_pend} queued lease request(s), "
+                      f"demand vector {n_dem} entry(ies)")
+        rows = flat
     if args.format == "json":
         print(json.dumps(rows, indent=2, default=repr))
         return 0
@@ -400,6 +420,75 @@ def cmd_profile(args) -> int:
     print(f"{captured}/{len(results)} worker(s) captured "
           f"({args.duration:.1f}s window)")
     return 0 if captured else 1
+
+
+def cmd_doctor(args) -> int:
+    """Aggregated cluster health diagnosis: dead-owner leases,
+    never-idle nodes, infeasible placement groups, hung collectives
+    (naming the op and missing ranks), stuck tasks, stragglers,
+    autoscaler decision gaps, recent flight dumps — each finding with
+    an explanation and the suggested next probe."""
+    from ray_tpu.util import doctor as doctor_mod
+
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    diag = doctor_mod.cluster_diagnosis(address=address)
+    if args.format == "json":
+        print(json.dumps(diag, indent=2, default=repr))
+    else:
+        sys.stdout.write(doctor_mod.render_text(diag))
+    critical = any(f.get("severity") == "critical"
+                   for f in diag.get("findings", []))
+    return 1 if critical else 0
+
+
+def cmd_explain(args) -> int:
+    """Scheduler explainability for one task: the full transition
+    chain (queued -> lease_requested -> pipelined/granted -> running
+    -> finished/requeued) with reason tags — why the task landed
+    where it did."""
+    from ray_tpu.util import state as state_api
+
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    r = state_api.explain_task(args.task_id, address=address)
+    if not r.get("ok"):
+        print(f"error: {r.get('error')}", file=sys.stderr)
+        return 1
+    rec = r["task"]
+    if args.format == "json":
+        print(json.dumps(rec, indent=2, default=repr))
+        return 0
+    print(f"task {rec.get('task_id')}  {rec.get('name', '?')} "
+          f"[{rec.get('state', '?')}]")
+    meta = []
+    if rec.get("node_id"):
+        meta.append(f"node={str(rec['node_id'])[:12]}")
+    if rec.get("worker_pid"):
+        meta.append(f"worker_pid={rec['worker_pid']}")
+    if rec.get("error"):
+        meta.append(f"error={rec['error']}")
+    if meta:
+        print("  " + "  ".join(meta))
+    # Stored (arrival) order, NOT sorted by timestamp: owner-side
+    # scheduling events and worker-side execution events carry
+    # different host clocks, and each plane flushes internally
+    # ordered — a raw-ts sort would let a skewed worker clock print
+    # RUNNING before PIPELINED.
+    chain = list(rec.get("transitions") or [])
+    if not chain:
+        print("  (no transitions recorded)")
+        return 0
+    t0 = chain[0][0]
+    for ts, state, detail in chain:
+        extras = "  ".join(f"{k}={v}" for k, v in
+                           sorted((detail or {}).items()))
+        print(f"  +{ts - t0:8.3f}s  {state:<16} {extras}")
+    return 0
 
 
 def cmd_metrics(args) -> int:
@@ -672,7 +761,7 @@ def _build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("list", help="state API listings")
     sp.add_argument("entity", choices=["tasks", "actors", "nodes",
                                        "objects", "jobs",
-                                       "placement-groups"])
+                                       "placement-groups", "leases"])
     sp.add_argument("--address", default="")
     sp.add_argument("--state", default="",
                     help="tasks only: RUNNING|FINISHED|FAILED")
@@ -707,6 +796,24 @@ def _build_parser() -> argparse.ArgumentParser:
                          "loaded it yet")
     sp.add_argument("--address", default="")
     sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser("doctor",
+                        help="aggregated cluster health diagnosis "
+                             "(hung collectives, dead-owner leases, "
+                             "stuck tasks, stragglers, ...)")
+    sp.add_argument("--address", default="")
+    sp.add_argument("--format", choices=["text", "json"],
+                    default="text")
+    sp.set_defaults(fn=cmd_doctor)
+
+    sp = sub.add_parser("explain",
+                        help="scheduling transition chain of one "
+                             "task (why it landed where it did)")
+    sp.add_argument("task_id", help="task id (prefix ok)")
+    sp.add_argument("--address", default="")
+    sp.add_argument("--format", choices=["text", "json"],
+                    default="text")
+    sp.set_defaults(fn=cmd_explain)
 
     sp = sub.add_parser("metrics",
                         help="print Prometheus metrics exposition")

@@ -1,0 +1,505 @@
+"""Cluster health & diagnosis plane — the data behind ``rt doctor``
+and the dashboard's ``/api/doctor`` route.
+
+Where ``rt telemetry`` answers *how much* TPU time is wasted and ``rt
+timeline`` answers *where*, this module answers *why*: it aggregates
+every health check the runtime exposes into one list of findings, each
+rendered with an explanation and the suggested next probe —
+
+  dead-owner leases     workers pinned by an owner whose connection is
+                        gone (``rt list leases``)
+  never-idle nodes      a node that reports busy while the cluster has
+                        no work — stranded leases/bundles
+  infeasible PGs        pending placement groups no alive node can
+                        ever host
+  hung collectives      gangs where some ranks entered op #N and the
+                        rest never arrived (names the op AND the
+                        missing ranks — the gang watchdog)
+  stuck tasks           RUNNING far past the historical p99 for that
+                        task name, or stuck in owner-side scheduling
+  stragglers            ranks consistently slower than the per-step
+                        median over a sliding window
+  autoscaler decisions  recent ticks with unsatisfiable demand
+  flight dumps          postmortems of recently dead workers
+
+The check functions are pure (plain dicts in, findings out) so they
+unit-test without a cluster; ``cluster_diagnosis`` wires them to a live
+controller.  Thresholds come from the standard flag table
+(``RT_COLLECTIVE_WATCHDOG_S``, ``RT_STUCK_TASK_MIN_S``,
+``RT_STUCK_TASK_P99_FACTOR``, ``RT_STRAGGLER_THRESHOLD``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+# Severity ordering for rendering (critical first).
+_SEV_ORDER = {"critical": 0, "warning": 1, "info": 2}
+
+
+def _finding(check: str, severity: str, summary: str,
+             detail: str = "", probe: str = "",
+             data: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    out = {"check": check, "severity": severity, "summary": summary,
+           "detail": detail, "probe": probe}
+    if data:
+        out["data"] = data
+    return out
+
+
+# ------------------------------------------------------ gang watchdog
+def find_hung_collectives(inflight: List[Dict], now: float,
+                          deadline_s: float) -> List[Dict]:
+    """Flag gangs where some ranks entered op #seq past the deadline
+    while other ranks never arrived — naming the op and the MISSING
+    ranks (the information a hang previously cost a log-reading
+    session per rank to recover)."""
+    out = []
+    for rec in inflight or []:
+        ranks = rec.get("ranks") or {}
+        world = int(rec.get("world", 0))
+        if not ranks or world <= 0:
+            continue
+        age = now - min(ranks.values())
+        entered = sorted(int(r) for r in ranks)
+        missing = sorted(set(range(world)) - set(entered))
+        op = rec.get("op", "?")
+        group = rec.get("group", "?")
+        seq = rec.get("seq", 0)
+        if missing and age > deadline_s:
+            # "Absent", not "never entered": a stamp clears on exit,
+            # so a rank that legitimately finished an asymmetric op
+            # early (cpu broadcast's source rank sends and leaves) is
+            # indistinguishable from one that never arrived — the
+            # finding must not send the operator to the wrong rank.
+            out.append(_finding(
+                "hung_collective", "critical",
+                f"collective {op!r} #{seq} in group {group!r} is hung: "
+                f"rank(s) {missing} absent — never entered, or "
+                f"already exited while the rest wait "
+                f"({len(entered)}/{world} waiting {age:.1f}s)",
+                detail=f"ranks {entered} stamped entry into "
+                       f"{op} #{seq} up to {age:.1f}s ago; the gang "
+                       f"cannot make progress until every rank joins.",
+                probe="rt timeline --summary; rt logs (an absent "
+                      "rank's worker); rt explain <its task id>",
+                data={"op": op, "group": group, "seq": seq,
+                      "missing_ranks": missing,
+                      "entered_ranks": entered, "age_s": age}))
+        elif not missing:
+            # "All ranks inside" is measured from the LAST entrant —
+            # the op cannot complete before every rank joins, so time
+            # spent waiting for a late rank is entry skew, not stall.
+            age_all = now - max(ranks.values())
+            if age_all <= deadline_s * 5:
+                continue
+            out.append(_finding(
+                "slow_collective", "warning",
+                f"collective {op!r} #{seq} in group {group!r} has all "
+                f"{world} ranks inside for {age_all:.1f}s",
+                detail="every rank entered but none exited — suspect "
+                       "a transport stall or a deadlock inside the "
+                       "op.",
+                probe="rt timeline --cluster; /api/stack on a member "
+                      "worker",
+                data={"op": op, "group": group, "seq": seq,
+                      "age_s": age_all}))
+    return out
+
+
+# -------------------------------------------------- stuck-task check
+def _p99(durations: List[float]) -> float:
+    if not durations:
+        return 0.0
+    s = sorted(durations)
+    return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+
+def find_stuck_tasks(tasks: List[Dict], now: float,
+                     min_s: float = 60.0,
+                     p99_factor: float = 3.0) -> List[Dict]:
+    """RUNNING tasks far past the historical p99 of same-named
+    finished tasks, and tasks stuck in owner-side scheduling (queued /
+    lease-requested / granted / requeued with no later transition).
+
+    DURATIONS come from reporter-clock ``times`` (same-host deltas,
+    skew-free); AGES come from the controller's receipt-clock shadow
+    ``times_recv`` when present — reporter wall clocks are not
+    comparable with ``now`` across hosts."""
+    by_name: Dict[str, List[float]] = {}
+    for rec in tasks or []:
+        times = rec.get("times") or {}
+        start, end = times.get("RUNNING"), times.get("FINISHED")
+        if start is not None and end is not None:
+            by_name.setdefault(rec.get("name", "?"), []).append(
+                max(end - start, 0.0))
+    out = []
+    for rec in tasks or []:
+        state = rec.get("state")
+        times = rec.get("times_recv") or rec.get("times") or {}
+        name = rec.get("name", "?")
+        tid = rec.get("task_id", "?")
+        if state == "RUNNING":
+            age = now - times.get("RUNNING", now)
+            p99 = _p99(by_name.get(name, []))
+            threshold = max(min_s, p99_factor * p99) if p99 \
+                else min_s
+            if age > threshold:
+                out.append(_finding(
+                    "stuck_task", "warning",
+                    f"task {name} ({tid[:16]}) RUNNING for "
+                    f"{age:.0f}s"
+                    + (f" (historical p99 {p99:.1f}s)" if p99
+                       else ""),
+                    detail="the task has been executing far beyond "
+                           "what same-named tasks historically took.",
+                    probe=f"rt explain {tid[:16]}; rt logs "
+                          f"--pid {rec.get('worker_pid', '?')}",
+                    data={"task_id": tid, "name": name, "age_s": age,
+                          "p99_s": p99}))
+        elif state in ("QUEUED", "LEASE_REQUESTED", "PIPELINED",
+                       "GRANTED", "REQUEUED"):
+            # Owner-side scheduling states with no execution yet: the
+            # demand exists but nothing is progressing it.  GRANTED/
+            # REQUEUED count too — a worker that died before its
+            # first event flush, or an owner that died before the
+            # re-push, parks the record there forever.
+            last_ts = max(times.values()) if times else now
+            age = now - last_ts
+            if age > min_s:
+                out.append(_finding(
+                    "pending_task", "warning",
+                    f"task {name} ({tid[:16]}) stuck in {state} for "
+                    f"{age:.0f}s with no progress",
+                    detail="the task is waiting on scheduling — a "
+                           "lease that never grants, demand the "
+                           "autoscaler is not satisfying, or a "
+                           "blocked pipeline.",
+                    probe=f"rt explain {tid[:16]}; rt list leases",
+                    data={"task_id": tid, "name": name,
+                          "state": state, "age_s": age}))
+    return out
+
+
+# --------------------------------------------------- straggler check
+def find_stragglers(spans: List[Dict], window: int = 20,
+                    threshold: float = 0.2,
+                    min_steps: int = 4) -> List[Dict]:
+    """Per-step straggler detection over the train_step span plane:
+    a rank whose step time exceeds the per-step MEDIAN by
+    ``threshold`` (fractionally), sustained across the sliding window
+    of recent steps, is flagged."""
+    steps: Dict[int, Dict[int, float]] = {}
+    for rec in spans or []:
+        if rec.get("cat") != "train_step":
+            continue
+        tags = rec.get("tags") or {}
+        try:
+            step = int(tags.get("step"))
+            rank = int(tags.get("rank", 0))
+        except (TypeError, ValueError):
+            continue
+        steps.setdefault(step, {})[rank] = max(
+            rec.get("end", 0.0) - rec.get("start", 0.0), 0.0)
+    recent = sorted(steps)[-window:]
+    excess: Dict[int, List[float]] = {}   # rank -> per-step excess frac
+    for step in recent:
+        durs = steps[step]
+        if len(durs) < 2:
+            continue
+        vals = sorted(durs.values())
+        n = len(vals)
+        # True median: on an even world the upper-middle element IS
+        # the slow rank when world=2, which would zero its own excess
+        # and blind the detector on any 2-host cluster.
+        median = vals[n // 2] if n % 2 \
+            else (vals[n // 2 - 1] + vals[n // 2]) / 2.0
+        if median <= 0:
+            continue
+        for rank, d in durs.items():
+            excess.setdefault(rank, []).append((d - median) / median)
+    out = []
+    for rank, fracs in sorted(excess.items()):
+        if len(fracs) < min_steps:
+            continue
+        mean_frac = sum(fracs) / len(fracs)
+        slow_steps = sum(1 for f in fracs if f > threshold)
+        if mean_frac > threshold and slow_steps >= len(fracs) / 2:
+            out.append(_finding(
+                "straggler", "warning",
+                f"rank {rank} is a straggler: "
+                f"{100 * mean_frac:.0f}% over the per-step median "
+                f"across {len(fracs)} recent steps",
+                detail=f"rank {rank} exceeded the median step time "
+                       f"in {slow_steps}/{len(fracs)} recent steps — "
+                       f"suspect a slow host, contended chips, or "
+                       f"input skew.",
+                probe="rt timeline --summary; rt profile --jax "
+                      "--node <its node>",
+                data={"rank": rank, "mean_excess_frac": mean_frac,
+                      "steps_observed": len(fracs)}))
+    return out
+
+
+# ------------------------------------------------- lease-plane check
+def find_lease_problems(ledgers: List[Dict], now: float,
+                        grace_s: float = 10.0) -> List[Dict]:
+    """Dead-owner leases from the fanned-out agent lease ledgers:
+    a lease whose owner connection has been gone past the grace is
+    capacity the cluster will never get back on its own.  The grace
+    is measured from the DISCONNECT (the agent's ledger tracks when
+    it first saw the owner gone), not from the grant — a momentary
+    re-dial mid-reregistration must not read as a dead owner."""
+    out = []
+    for ledger in ledgers or []:
+        node = str(ledger.get("node_id", "?"))[:12]
+        for lease in ledger.get("leases", []):
+            if lease.get("owner_tag") and \
+                    not lease.get("owner_connected", True) and \
+                    lease.get("owner_disconnected_s",
+                              0.0) > grace_s:
+                out.append(_finding(
+                    "dead_owner_lease", "critical",
+                    f"lease {lease['lease_id']} on node {node} is "
+                    f"held by owner {lease['owner_tag']!r} "
+                    f"disconnected for "
+                    f"{lease.get('owner_disconnected_s', 0):.0f}s",
+                    detail="the owning process's connection is gone; "
+                           "if it does not reconnect the agent's "
+                           "reclaim sweep should free it — a lease "
+                           "surviving here long past the grace means "
+                           "the sweep is not firing.",
+                    probe=f"rt list leases; rt logs --pid "
+                          f"{lease.get('worker_pid', '?')}",
+                    data={"node": node, **{k: lease.get(k) for k in
+                          ("lease_id", "owner_tag", "worker_pid",
+                           "age_s", "owner_disconnected_s")}}))
+    return out
+
+
+def find_never_idle_nodes(load: Dict, ledgers: List[Dict],
+                          running_tasks: int,
+                          tasks: Optional[List[Dict]] = None,
+                          now: Optional[float] = None,
+                          busy_floor_s: float = 60.0) -> List[Dict]:
+    """A node that reports itself busy (idle_s ~ 0) while the cluster
+    has had no demand and no running tasks for at least
+    ``busy_floor_s``: leases or bundles are pinning it, which also
+    blinds the autoscaler's scale-down (the round-5 never-idle
+    TPU-slice weakness).  The floor keeps warm pooled leases in the
+    window right after a workload finishes — normal keepalive
+    behavior — from reading as a stranded node."""
+    if running_tasks or (load or {}).get("pending_demands") or \
+            (load or {}).get("pending_placement_groups"):
+        return []
+    if now is not None and tasks:
+        last_activity = max(
+            (max((t.get("times_recv") or t["times"]).values())
+             for t in tasks if t.get("times")), default=0.0)
+        if last_activity and now - last_activity < busy_floor_s:
+            return []  # the cluster only just went quiet
+    by_node = {str(l.get("node_id", ""))[:12]: l
+               for l in ledgers or []}
+    out = []
+    for nid, info in ((load or {}).get("nodes") or {}).items():
+        if info.get("idle_s", 0.0) >= 1.0:
+            continue
+        ledger = by_node.get(nid[:12], {})
+        n_leases = len(ledger.get("leases", []))
+        out.append(_finding(
+            "never_idle_node", "warning",
+            f"node {nid[:12]} reports busy with no cluster work "
+            f"({n_leases} lease(s) held)",
+            detail="nothing is running cluster-wide yet this node "
+                   "never goes idle — held leases or placement-group "
+                   "bundles are pinning it, and the autoscaler will "
+                   "never scale it down.",
+            probe="rt list leases; rt list placement-groups",
+            data={"node": nid, "leases": n_leases}))
+    return out
+
+
+def find_infeasible_pgs(pgs: List[Dict], nodes: List[Dict]
+                        ) -> List[Dict]:
+    """Pending placement groups with a bundle no alive node's TOTAL
+    resources can ever host: they will pend forever unless a new node
+    type joins."""
+    totals = [n.get("resources") or {} for n in nodes or []
+              if n.get("alive")]
+
+    def _fits_any(bundle: Dict[str, float]) -> bool:
+        return any(all(t.get(k, 0.0) >= v for k, v in bundle.items())
+                   for t in totals)
+
+    out = []
+    for pg in pgs or []:
+        if pg.get("state") not in ("PENDING", "RESCHEDULING"):
+            continue
+        bad = [b for b in pg.get("bundles", []) if not _fits_any(b)]
+        if bad:
+            pid = str(pg.get("pg_id", "?"))
+            out.append(_finding(
+                "infeasible_placement_group", "critical",
+                f"placement group {pid[:16]} is {pg.get('state')} "
+                f"with {len(bad)} bundle(s) no alive node can host",
+                detail=f"bundle(s) {bad} exceed every alive node's "
+                       f"total resources; the group pends forever "
+                       f"unless a capable node joins.",
+                probe="rt list nodes; rt list placement-groups",
+                data={"pg_id": pid, "state": pg.get("state"),
+                      "infeasible_bundles": bad}))
+    return out
+
+
+def find_autoscaler_gaps(decisions: List[Dict], now: float,
+                         horizon_s: float = 300.0) -> List[Dict]:
+    """Recent autoscaler ticks that saw demand no launchable node
+    type satisfies — the decision ring makes demand blindness visible
+    at runtime instead of forensically."""
+    recent = [d for d in decisions or []
+              if now - d.get("ts", 0.0) <= horizon_s
+              and d.get("unsatisfied")]
+    if not recent:
+        return []
+    last = recent[-1]
+    return [_finding(
+        "autoscaler_unsatisfied_demand", "warning",
+        f"autoscaler saw unsatisfiable demand in {len(recent)} "
+        f"recent tick(s), latest: {last['unsatisfied'][:3]}",
+        detail="demand exists that fits no launchable node type "
+               "(check max_workers caps and declared node-type "
+               "resources).",
+        probe="rt list leases (demand vector); autoscaler spec",
+        data={"ticks": len(recent),
+              "latest_unsatisfied": last["unsatisfied"][:10]})]
+
+
+def find_flight_dumps(dumps: List[Dict], now: float,
+                      horizon_s: float = 3600.0) -> List[Dict]:
+    out = []
+    for d in dumps or []:
+        # Age against the controller's receipt time when present: the
+        # dump's own ts is the dying worker's wall clock, which can
+        # sit hours off the controller clock `now` comes from.
+        ts = d.get("ts_recv") or d.get("ts") or 0.0
+        if now - ts > horizon_s:
+            continue
+        last = (d.get("sticky") or {}).get("last_task") or {}
+        out.append(_finding(
+            "flight_dump", "info",
+            f"worker {d.get('source', '?')} died "
+            f"{now - ts:.0f}s ago"
+            + (f" while in {last.get('name')}[{last.get('state')}]"
+               if last else ""),
+            detail=f"reason={d.get('reason', '?')!r}; the flight-"
+                   f"recorder ring was dumped for postmortem.",
+            probe=(f"cat {d['path']}" if d.get("path")
+                   else "rt telemetry"),
+            data={"source": d.get("source"), "ts": ts,
+                  "reason": d.get("reason")}))
+    return out
+
+
+# ----------------------------------------------------- orchestration
+def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
+             load: Dict, pgs: List[Dict], nodes: List[Dict],
+             ledgers: List[Dict], now: Optional[float] = None,
+             collective_watchdog_s: float = 30.0,
+             stuck_task_min_s: float = 60.0,
+             stuck_task_p99_factor: float = 3.0,
+             straggler_threshold: float = 0.2) -> Dict[str, Any]:
+    """Pure aggregation of every check over already-fetched state
+    (unit-testable without a cluster)."""
+    now = time.time() if now is None else now
+    running = sum(1 for t in tasks or []
+                  if t.get("state") == "RUNNING")
+    findings: List[Dict] = []
+    findings += find_hung_collectives(
+        feed.get("collective_inflight") or [], now,
+        collective_watchdog_s)
+    findings += find_lease_problems(ledgers, now)
+    findings += find_infeasible_pgs(pgs, nodes)
+    findings += find_stuck_tasks(tasks, now, min_s=stuck_task_min_s,
+                                 p99_factor=stuck_task_p99_factor)
+    findings += find_stragglers(spans, threshold=straggler_threshold)
+    findings += find_never_idle_nodes(load, ledgers, running,
+                                      tasks=tasks, now=now)
+    findings += find_autoscaler_gaps(
+        feed.get("autoscaler_decisions") or [], now)
+    findings += find_flight_dumps(feed.get("flight") or [], now)
+    findings.sort(key=lambda f: _SEV_ORDER.get(f["severity"], 9))
+    return {
+        "ts": now,
+        "healthy": not any(f["severity"] in ("critical", "warning")
+                           for f in findings),
+        "findings": findings,
+        "checked": {
+            "nodes": len([n for n in nodes or [] if n.get("alive")]),
+            "tasks": len(tasks or []),
+            "leases": sum(len(l.get("leases", []))
+                          for l in ledgers or []),
+            "collectives_inflight": len(
+                feed.get("collective_inflight") or []),
+        },
+    }
+
+
+def cluster_diagnosis(*, address: Optional[str] = None
+                      ) -> Dict[str, Any]:
+    """Assemble the full diagnosis from a live controller + agents
+    (the `rt doctor` / /api/doctor entry point)."""
+    from ..core.config import RuntimeConfig
+    from . import state as state_api
+
+    config = RuntimeConfig.from_env()
+    feed = state_api.doctor_feed(address=address)
+    tasks = state_api.list_tasks(limit=10000, address=address)
+    try:
+        spans = state_api.list_spans(limit=20000, cat="train_step",
+                                     address=address)
+    except Exception:
+        spans = []
+    load = state_api.load_metrics(address=address)
+    try:
+        pgs = state_api.list_placement_groups(address=address)
+    except Exception:
+        pgs = []
+    nodes = state_api.list_nodes(address=address)
+    ledgers = state_api.list_leases(address=address)
+    return diagnose(
+        feed=feed, tasks=tasks, spans=spans, load=load, pgs=pgs,
+        nodes=nodes, ledgers=ledgers,
+        # Diagnose against the CONTROLLER's clock: collective entry
+        # times are rebased onto it at report time, and the CLI/
+        # dashboard host running this function may be skewed.
+        now=feed.get("ts"),
+        collective_watchdog_s=config.collective_watchdog_s,
+        stuck_task_min_s=config.stuck_task_min_s,
+        stuck_task_p99_factor=config.stuck_task_p99_factor,
+        straggler_threshold=config.straggler_threshold)
+
+
+def render_text(diag: Dict[str, Any]) -> str:
+    """Human-readable doctor report for the CLI."""
+    checked = diag.get("checked", {})
+    lines = [f"Cluster health check "
+             f"({checked.get('nodes', 0)} node(s), "
+             f"{checked.get('leases', 0)} lease(s), "
+             f"{checked.get('tasks', 0)} task record(s), "
+             f"{checked.get('collectives_inflight', 0)} "
+             f"collective(s) in flight):"]
+    findings = diag.get("findings", [])
+    if not findings:
+        lines.append("  all checks passed — no findings.")
+        return "\n".join(lines) + "\n"
+    for f in findings:
+        lines.append(f"\n[{f['severity'].upper():>8}] "
+                     f"{f['check']}: {f['summary']}")
+        if f.get("detail"):
+            lines.append(f"           {f['detail']}")
+        if f.get("probe"):
+            lines.append(f"           next: {f['probe']}")
+    if diag.get("healthy"):
+        lines.append("\nNo critical or warning findings.")
+    return "\n".join(lines) + "\n"

@@ -190,6 +190,52 @@ def timeline_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
                                             address=address))
 
 
+def explain_task(task_id: str, *, address: Optional[str] = None
+                 ) -> Dict[str, Any]:
+    """Scheduler explainability: the full transition chain (queued ->
+    lease_requested -> pipelined/granted -> running -> finished/
+    requeued, each with reason tags) of one task — ``rt explain``.
+    Accepts a task-id prefix."""
+    return _call("explain_task", {"task_id": task_id}, address)
+
+
+def doctor_feed(*, address: Optional[str] = None) -> Dict[str, Any]:
+    """Raw controller health feed: merged collective-entry stamps,
+    the autoscaler decision ring, retained flight dumps."""
+    return _call("doctor_feed", {}, address)
+
+
+def load_metrics(*, address: Optional[str] = None) -> Dict[str, Any]:
+    """The autoscaler's input view: per-node utilization/idle age +
+    the cluster demand vector."""
+    return _call("get_load_metrics", {}, address)
+
+
+def list_leases(*, node_id: Optional[str] = None,
+                address: Optional[str] = None) -> List[Dict]:
+    """Fan out over alive node agents and return each node's lease
+    ledger (held leases with owner tag / pipeline depth / idle age,
+    queued lease requests, and the advertised demand vector) — the
+    ``rt list leases`` data."""
+    out = []
+    for n in _agents(node_id, address):
+        try:
+            out.append(_agent_call(n["agent_addr"], "list_leases"))
+        except Exception as e:  # noqa: BLE001 — one dead agent must
+            # not hide every other node's ledger
+            out.append({"node_id": n["node_id"],
+                        "error": f"agent unreachable: {e}"})
+    return out
+
+
+def doctor(*, address: Optional[str] = None) -> Dict[str, Any]:
+    """The aggregated health diagnosis (``rt doctor`` /
+    ``/api/doctor``); see util/doctor.py for the checks."""
+    from . import doctor as doctor_mod
+
+    return doctor_mod.cluster_diagnosis(address=address)
+
+
 def summarize_tasks(*, address: Optional[str] = None) -> Dict[str, int]:
     counts: Dict[str, int] = {}
     for rec in list_tasks(limit=100000, address=address):

@@ -1,0 +1,382 @@
+"""Health & diagnosis plane units: the pure doctor checks (gang
+watchdog, stuck tasks, stragglers, lease/PG/autoscaler findings), the
+controller's transition-chain sink + explain_task, and the doctor
+text renderer — no cluster required (tier-1 fast path).
+
+Ref: ISSUE 3 — scheduler explainability, gang watchdog, straggler
+detection, `rt doctor`.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from ray_tpu.util import doctor
+
+
+NOW = 1_000_000.0
+
+
+# ------------------------------------------------------ gang watchdog
+def test_hung_collective_names_op_and_missing_ranks():
+    inflight = [{"group": "g", "seq": 7, "op": "allreduce",
+                 "backend": "cpu", "world": 4,
+                 "ranks": {0: NOW - 12.0, 2: NOW - 11.5}}]
+    found = doctor.find_hung_collectives(inflight, NOW, deadline_s=5.0)
+    assert len(found) == 1
+    f = found[0]
+    assert f["check"] == "hung_collective"
+    assert f["severity"] == "critical"
+    assert f["data"]["op"] == "allreduce"
+    assert f["data"]["seq"] == 7
+    assert f["data"]["missing_ranks"] == [1, 3]
+    assert "allreduce" in f["summary"]
+    assert "[1, 3]" in f["summary"]
+
+
+def test_collective_within_deadline_not_flagged():
+    inflight = [{"group": "g", "seq": 1, "op": "barrier",
+                 "backend": "cpu", "world": 2,
+                 "ranks": {0: NOW - 1.0}}]
+    assert doctor.find_hung_collectives(inflight, NOW, 5.0) == []
+
+
+def test_all_ranks_inside_flags_slow_not_hung():
+    ranks = {r: NOW - 30.0 for r in range(2)}
+    inflight = [{"group": "g", "seq": 3, "op": "allgather",
+                 "backend": "xla", "world": 2, "ranks": ranks}]
+    found = doctor.find_hung_collectives(inflight, NOW, 5.0)
+    assert [f["check"] for f in found] == ["slow_collective"]
+
+
+# -------------------------------------------------------- stuck tasks
+def _task(tid, name, state, times):
+    return {"task_id": tid, "name": name, "state": state,
+            "times": times}
+
+
+def test_stuck_running_task_uses_historical_p99():
+    tasks = [
+        _task(f"f{i}", "fn", "FINISHED",
+              {"RUNNING": NOW - 100 - i, "FINISHED": NOW - 99.5 - i})
+        for i in range(20)
+    ]  # p99 ~ 0.5s
+    tasks.append(_task("stuck1", "fn", "RUNNING",
+                       {"RUNNING": NOW - 70}))
+    found = doctor.find_stuck_tasks(tasks, NOW, min_s=60.0,
+                                    p99_factor=3.0)
+    assert [f["data"]["task_id"] for f in found] == ["stuck1"]
+    assert "rt explain" in found[0]["probe"]
+
+
+def test_running_task_below_floor_not_flagged():
+    tasks = [_task("t1", "fn", "RUNNING", {"RUNNING": NOW - 10})]
+    assert doctor.find_stuck_tasks(tasks, NOW, min_s=60.0) == []
+
+
+def test_pending_task_with_no_progress_flagged():
+    tasks = [_task("t1", "fn", "QUEUED", {"QUEUED": NOW - 120})]
+    found = doctor.find_stuck_tasks(tasks, NOW, min_s=60.0)
+    assert found and found[0]["check"] == "pending_task"
+    assert found[0]["data"]["state"] == "QUEUED"
+
+
+# --------------------------------------------------------- stragglers
+def _step_span(step, rank, dur):
+    return {"cat": "train_step", "start": NOW + step,
+            "end": NOW + step + dur,
+            "tags": {"step": step, "rank": rank}}
+
+
+def test_straggler_detected_over_window():
+    spans = []
+    for step in range(10):
+        for rank in range(4):
+            dur = 0.13 if rank == 2 else 0.10  # rank 2: +30%
+            spans.append(_step_span(step, rank, dur))
+    found = doctor.find_stragglers(spans, threshold=0.2)
+    assert [f["data"]["rank"] for f in found] == [2]
+    assert "straggler" in found[0]["summary"]
+
+
+def test_balanced_ranks_no_straggler():
+    spans = [_step_span(step, rank, 0.1)
+             for step in range(10) for rank in range(4)]
+    assert doctor.find_stragglers(spans) == []
+
+
+def test_one_off_slow_step_not_a_straggler():
+    spans = []
+    for step in range(10):
+        for rank in range(2):
+            dur = 0.5 if (rank == 1 and step == 3) else 0.1
+            spans.append(_step_span(step, rank, dur))
+    assert doctor.find_stragglers(spans, threshold=0.2) == []
+
+
+# ------------------------------------------------------- lease checks
+def test_dead_owner_lease_flagged():
+    ledgers = [{"node_id": "abcd1234", "leases": [
+        {"lease_id": 5, "owner_tag": "rt-999", "owner_connected": False,
+         "worker_pid": 42, "age_s": 120.0,
+         "owner_disconnected_s": 30.0},
+        # Momentary disconnect (a re-dial mid-reregistration): old
+        # lease, owner gone for a fraction of a second — NOT dead.
+        {"lease_id": 8, "owner_tag": "rt-2", "owner_connected": False,
+         "worker_pid": 45, "age_s": 120.0,
+         "owner_disconnected_s": 0.4},
+        {"lease_id": 6, "owner_tag": "rt-1", "owner_connected": True,
+         "worker_pid": 43, "age_s": 120.0},
+        {"lease_id": 7, "owner_tag": "", "owner_connected": True,
+         "worker_pid": 44, "age_s": 500.0},  # actor lease: fine
+    ]}]
+    found = doctor.find_lease_problems(ledgers, NOW, grace_s=10.0)
+    assert [f["data"]["lease_id"] for f in found] == [5]
+    assert found[0]["severity"] == "critical"
+
+
+def test_never_idle_node_needs_quiet_cluster():
+    load = {"nodes": {"aaaa": {"idle_s": 0.0}},
+            "pending_demands": [], "pending_placement_groups": []}
+    ledgers = [{"node_id": "aaaa", "leases": [{"lease_id": 1}]}]
+    found = doctor.find_never_idle_nodes(load, ledgers,
+                                         running_tasks=0)
+    assert found and found[0]["check"] == "never_idle_node"
+    # With running work the same state is normal.
+    assert doctor.find_never_idle_nodes(load, ledgers,
+                                        running_tasks=3) == []
+    # Recent task activity (warm pooled leases right after a workload
+    # finished) suppresses the finding until the floor elapses.
+    recent = [{"times": {"FINISHED": NOW - 5.0}}]
+    assert doctor.find_never_idle_nodes(
+        load, ledgers, running_tasks=0, tasks=recent, now=NOW,
+        busy_floor_s=60.0) == []
+    stale = [{"times": {"FINISHED": NOW - 300.0}}]
+    assert doctor.find_never_idle_nodes(
+        load, ledgers, running_tasks=0, tasks=stale, now=NOW,
+        busy_floor_s=60.0)
+
+
+def test_infeasible_pg_flagged():
+    pgs = [{"pg_id": "pg1", "state": "PENDING",
+            "bundles": [{"CPU": 64.0}]},
+           {"pg_id": "pg2", "state": "PENDING",
+            "bundles": [{"CPU": 1.0}]}]
+    nodes = [{"alive": True, "resources": {"CPU": 8.0}}]
+    found = doctor.find_infeasible_pgs(pgs, nodes)
+    assert [f["data"]["pg_id"] for f in found] == ["pg1"]
+
+
+def test_autoscaler_unsatisfied_demand_surfaced():
+    decisions = [{"ts": NOW - 10, "unsatisfied": [{"TPU": 128.0}],
+                  "launched": [], "terminated": []}]
+    found = doctor.find_autoscaler_gaps(decisions, NOW)
+    assert found and "TPU" in str(found[0]["data"])
+    # Old decisions age out of the horizon.
+    assert doctor.find_autoscaler_gaps(decisions, NOW + 10_000) == []
+
+
+# ------------------------------------------------- aggregation/render
+def test_diagnose_healthy_and_render():
+    diag = doctor.diagnose(feed={}, tasks=[], spans=[], load={},
+                           pgs=[], nodes=[], ledgers=[], now=NOW)
+    assert diag["healthy"] is True
+    text = doctor.render_text(diag)
+    assert "all checks passed" in text
+
+
+def test_diagnose_orders_critical_first():
+    feed = {"collective_inflight": [
+        {"group": "g", "seq": 1, "op": "allreduce", "world": 2,
+         "ranks": {0: NOW - 100}}]}
+    spans = []
+    for step in range(10):
+        spans.append(_step_span(step, 0, 0.1))
+        spans.append(_step_span(step, 1, 0.2))
+    diag = doctor.diagnose(feed=feed, tasks=[], spans=spans, load={},
+                           pgs=[], nodes=[], ledgers=[], now=NOW,
+                           collective_watchdog_s=5.0)
+    assert diag["healthy"] is False
+    sevs = [f["severity"] for f in diag["findings"]]
+    assert sevs == sorted(sevs, key=lambda s: {"critical": 0,
+                                               "warning": 1,
+                                               "info": 2}[s])
+    text = doctor.render_text(diag)
+    assert "CRITICAL" in text and "hung_collective" in text
+    assert "next:" in text
+
+
+# -------------------------- controller sink: transitions + explain
+def _controller():
+    from ray_tpu.core.config import RuntimeConfig
+    from ray_tpu.core.controller import Controller
+
+    return Controller(RuntimeConfig.from_env(), "doctor-unit")
+
+
+def test_transition_chain_and_explain_prefix():
+    ctl = _controller()
+
+    async def go():
+        await ctl.task_events({"events": [
+            {"task_id": "aabbccdd", "state": "QUEUED", "ts": 1.0,
+             "name": "fn", "detail": {"strategy": "DEFAULT"}},
+            {"task_id": "aabbccdd", "state": "PIPELINED", "ts": 2.0,
+             "name": "fn",
+             "detail": {"lease_id": 3, "reason": "idle_lease"}},
+            {"task_id": "aabbccdd", "state": "RUNNING", "ts": 3.0,
+             "name": "fn"},
+            {"task_id": "aabbccdd", "state": "FINISHED", "ts": 4.0,
+             "name": "fn"},
+        ]})
+        full = await ctl.explain_task({"task_id": "aabbccdd"})
+        pref = await ctl.explain_task({"task_id": "aabb"})
+        missing = await ctl.explain_task({"task_id": "zz"})
+        return full, pref, missing
+
+    full, pref, missing = asyncio.run(go())
+    assert full["ok"] and pref["ok"] and not missing["ok"]
+    chain = full["task"]["transitions"]
+    assert [s for _ts, s, _d in chain] == [
+        "QUEUED", "PIPELINED", "RUNNING", "FINISHED"]
+    assert chain[1][2] == {"lease_id": 3, "reason": "idle_lease"}
+    assert pref["task"] is full["task"]
+
+
+def test_headline_state_survives_cross_host_clock_skew():
+    """Owner and worker timestamps come from different hosts: a
+    skewed owner clock ahead of the worker's must not overwrite a
+    terminal state with a scheduling state (lifecycle tiers beat raw
+    timestamps across the two planes)."""
+    ctl = _controller()
+
+    async def go():
+        # Worker events land first with an EARLIER (behind) clock...
+        await ctl.task_events({"events": [
+            {"task_id": "skew1", "state": "RUNNING", "ts": 98.1},
+            {"task_id": "skew1", "state": "FINISHED", "ts": 98.2},
+        ]})
+        # ...then owner-side scheduling events with a later clock.
+        await ctl.task_events({"events": [
+            {"task_id": "skew1", "state": "QUEUED", "ts": 99.9},
+            {"task_id": "skew1", "state": "PIPELINED", "ts": 100.0},
+        ]})
+        return await ctl.explain_task({"task_id": "skew1"})
+
+    r = asyncio.run(go())
+    assert r["task"]["state"] == "FINISHED"
+    # The transition chain still records every event.
+    assert len(r["task"]["transitions"]) == 4
+
+
+def test_retry_attempt_supersedes_prior_failed_headline():
+    """A retried task's second attempt must displace the first
+    attempt's FAILED headline (attempt outranks lifecycle tier),
+    even though FAILED is terminal."""
+    ctl = _controller()
+
+    async def go():
+        await ctl.task_events({"events": [
+            {"task_id": "rt1", "state": "RUNNING", "ts": 10.0,
+             "attempt": 0},
+            {"task_id": "rt1", "state": "FAILED", "ts": 11.0,
+             "attempt": 0},
+            # retry: owner resubmits, worker runs attempt 1
+            {"task_id": "rt1", "state": "QUEUED", "ts": 11.5,
+             "attempt": 1},
+            {"task_id": "rt1", "state": "RUNNING", "ts": 12.0,
+             "attempt": 1},
+        ]})
+        return await ctl.explain_task({"task_id": "rt1"})
+
+    r = asyncio.run(go())
+    assert r["task"]["state"] == "RUNNING"
+    assert r["task"]["attempt"] == 1
+    # The chain tags retry transitions with their attempt.
+    assert any(d.get("attempt") == 1
+               for _ts, _s, d in r["task"]["transitions"])
+
+
+def test_collective_entry_rebased_to_controller_clock():
+    """Reporters ship age deltas; the controller rebases entry times
+    onto its own clock so watchdog ages survive host clock skew."""
+    ctl = _controller()
+
+    async def go():
+        before = time.time()
+        await ctl.collective_entries({"source": "w1", "entries": [
+            {"group": "g", "seq": 1, "op": "allreduce", "world": 2,
+             "rank": 0, "since": before - 10_000.0,  # skewed clock
+             "age_s": 3.0}]})
+        merged = ctl._merged_collective_inflight(time.time())
+        return before, merged
+
+    before, merged = asyncio.run(go())
+    assert len(merged) == 1
+    since = merged[0]["ranks"][0]
+    # Rebased: ~3s before the report, NOT the skewed raw stamp.
+    assert abs((before - 3.0) - since) < 1.0
+
+
+def test_explain_ambiguous_prefix():
+    ctl = _controller()
+
+    async def go():
+        await ctl.task_events({"events": [
+            {"task_id": "aa11", "state": "QUEUED", "ts": 1.0},
+            {"task_id": "aa22", "state": "QUEUED", "ts": 1.0},
+        ]})
+        return await ctl.explain_task({"task_id": "aa"})
+
+    r = asyncio.run(go())
+    assert not r["ok"] and "ambiguous" in r["error"]
+
+
+def test_transition_chain_bounded():
+    ctl = _controller()
+
+    async def go():
+        for i in range(200):
+            await ctl.task_events({"events": [
+                {"task_id": "t1", "state": "REQUEUED",
+                 "ts": float(i)}]})
+        return await ctl.explain_task({"task_id": "t1"})
+
+    r = asyncio.run(go())
+    assert len(r["task"]["transitions"]) == 64
+
+
+def test_collective_entries_replace_semantics():
+    ctl = _controller()
+
+    async def go():
+        await ctl.collective_entries({"source": "w1", "entries": [
+            {"group": "g", "seq": 1, "op": "allreduce", "world": 2,
+             "rank": 0, "since": time.time()}]})
+        await ctl.collective_entries({"source": "w2", "entries": [
+            {"group": "g", "seq": 1, "op": "allreduce", "world": 2,
+             "rank": 1, "since": time.time()}]})
+        merged = ctl._merged_collective_inflight(time.time())
+        # w1 exits op #1 -> its next report is empty.
+        await ctl.collective_entries({"source": "w1", "entries": []})
+        merged2 = ctl._merged_collective_inflight(time.time())
+        return merged, merged2
+
+    merged, merged2 = asyncio.run(go())
+    assert len(merged) == 1 and sorted(merged[0]["ranks"]) == [0, 1]
+    assert len(merged2) == 1 and sorted(merged2[0]["ranks"]) == [1]
+
+
+def test_doctor_feed_shape():
+    ctl = _controller()
+
+    async def go():
+        await ctl.report_autoscaler_decision(
+            {"demands": 2, "unsatisfied": [{"TPU": 8.0}]})
+        return await ctl.doctor_feed({})
+
+    feed = asyncio.run(go())
+    assert "collective_inflight" in feed
+    assert feed["autoscaler_decisions"][0]["unsatisfied"] == \
+        [{"TPU": 8.0}]

@@ -52,13 +52,17 @@ def test_task_events_and_state_api(rt):
     with pytest.raises(RuntimeError):
         ray_tpu.get(bad_task.remote(), timeout=60)
 
-    tasks = _wait(
-        lambda: [t for t in state_api.list_tasks()
+    # Owner-side scheduling events create records BEFORE the worker's
+    # execution events land (the explainability plane), so wait for
+    # the TERMINAL states, not mere record existence.
+    def _terminal():
+        tasks = [t for t in state_api.list_tasks()
                  if t.get("name") in ("ok_task", "bad_task")]
-        if len([t for t in state_api.list_tasks()
-                if t.get("name") in ("ok_task", "bad_task")]) >= 2
-        else None,
-        what="task events to arrive")
+        if {t.get("state") for t in tasks} >= {"FINISHED", "FAILED"}:
+            return tasks
+        return None
+
+    tasks = _wait(_terminal, what="task events to arrive")
     by_name = {t["name"]: t for t in tasks}
     ok = by_name["ok_task"]
     assert ok["state"] == "FINISHED"

@@ -1,0 +1,71 @@
+"""Reply-loss reconnect path (the PROGRESS.jsonl flake): a streaming
+actor call's final push_actor_task reply used to be silently dropped
+when the notify raced a connection reregistration — the stream never
+finalized and the driver hung forever.
+
+The fix is two-sided and this test pins both halves end-to-end:
+ - worker: undeliverable peer notifies (stream items AND the final
+   batched reply) are re-buffered in order and redelivered when the
+   owner's tag re-registers (worker_main._send_peer);
+ - owner: a dropped worker connection with an actor reply in flight
+   re-dials (re-registering the tag, which triggers redelivery) and
+   only fails after the grace (cluster_runtime._await_reply_redelivery).
+
+A 50-iteration streaming-actor loop severs the owner connection from
+the WORKER side mid-generator at iteration 25 — the reply frames for
+that call have nowhere to go until the owner reconnects — and asserts
+every final reply (and every streamed item) still arrives.
+"""
+
+import time
+
+import ray_tpu
+
+
+def test_streaming_actor_replies_survive_forced_reconnect():
+    ray_tpu.shutdown()
+    ray_tpu.init(mode="cluster", num_cpus=2)
+    try:
+        @ray_tpu.remote
+        class Chunker:
+            def chunks(self, i, n, sever_at):
+                for j in range(n):
+                    if i == sever_at and j == 1:
+                        # Sever the owner's registered server-side
+                        # connection(s) abruptly from INSIDE the
+                        # worker: exactly the window where reply
+                        # frames have nowhere to go until the owner
+                        # re-registers (the reregistration race,
+                        # induced deterministically).
+                        from ray_tpu.core import runtime as rmod
+
+                        rt = rmod.get_runtime()
+                        # The worker wired itself in as the block
+                        # hook; its __self__ is the Worker object.
+                        worker = rt.on_block.__self__
+                        conns = worker.server._conns
+                        for tag in [t for t in list(conns)
+                                    if t.startswith("owner-")]:
+                            wr = conns.pop(tag)
+                            worker._loop.call_soon_threadsafe(
+                                wr.close)
+                    yield i * 100 + j
+
+        c = Chunker.remote()
+        deadline = time.time() + 240
+        for i in range(50):
+            assert time.time() < deadline, \
+                f"reply-loss loop stalled at iteration {i}"
+            gen = c.chunks.options(
+                num_returns="streaming").remote(i, 3, 25)
+            items = []
+            while True:
+                try:
+                    ref = gen._next_ref(timeout=60)
+                except StopIteration:
+                    break
+                items.append(ray_tpu.get(ref, timeout=60))
+            assert items == [i * 100 + j for j in range(3)], \
+                f"iteration {i} lost items: {items}"
+    finally:
+        ray_tpu.shutdown()

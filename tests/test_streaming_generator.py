@@ -77,33 +77,48 @@ def test_streaming_mid_generator_failure(rt):
         ray_tpu.get(refs[2])
 
 
-def test_streaming_backpressure(rt):
-    """The executor pauses when the consumer lags: a 100-item stream
-    must not have produced all items while the consumer has read none
-    (window is 16)."""
-    @ray_tpu.remote(num_returns="streaming")
-    def counted_gen():
+def test_streaming_backpressure():
+    """With a BOUNDED window (streaming_max_pending — default is 0 =
+    unbounded, the reference behavior) the executor pauses when the
+    consumer lags: a 100-item stream must not have produced all items
+    while the consumer has read none."""
+    had_runtime = ray_tpu.is_initialized()
+    ray_tpu.shutdown()
+    ray_tpu.init(mode="cluster", num_cpus=2,
+                 config={"streaming_max_pending": 16})
+    try:
+        @ray_tpu.remote(num_returns="streaming")
+        def counted_gen():
+            import os
+            import tempfile
+
+            marker = os.path.join(tempfile.gettempdir(),
+                                  "rt_stream_count.txt")
+            for i in range(100):
+                with open(marker, "w") as f:
+                    f.write(str(i))
+                yield i
+
+        g = counted_gen.remote()
+        time.sleep(3.0)  # producer runs ahead here if unbounded
         import os
         import tempfile
 
         marker = os.path.join(tempfile.gettempdir(),
                               "rt_stream_count.txt")
-        for i in range(100):
-            with open(marker, "w") as f:
-                f.write(str(i))
-            yield i
-
-    g = counted_gen.remote()
-    time.sleep(3.0)  # give the producer time to run ahead if unbounded
-    import os
-    import tempfile
-
-    marker = os.path.join(tempfile.gettempdir(), "rt_stream_count.txt")
-    with open(marker) as f:
-        produced_before_consume = int(f.read())
-    assert produced_before_consume < 40, \
-        f"producer ran {produced_before_consume} items ahead unbounded"
-    assert [ray_tpu.get(r, timeout=60) for r in g] == list(range(100))
+        with open(marker) as f:
+            produced_before_consume = int(f.read())
+        assert produced_before_consume < 40, \
+            f"producer ran {produced_before_consume} items ahead " \
+            f"unbounded"
+        assert [ray_tpu.get(r, timeout=60) for r in g] == \
+            list(range(100))
+    finally:
+        ray_tpu.shutdown()
+        if had_runtime:
+            # Restore the module fixture's shared runtime for the
+            # tests that follow.
+            ray_tpu.init(mode="cluster", num_cpus=2)
 
 
 def test_streaming_cancel(rt):
