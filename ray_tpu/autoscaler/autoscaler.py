@@ -118,14 +118,16 @@ class StandardAutoscaler:
         "terminated": [...]} for tests/introspection."""
         lm = await self._cli.call("get_load_metrics", {})
         self._unsatisfied: List[Dict[str, float]] = []
+        preempted = await self._reap_preempted()
         launched = await self._scale_up(lm)
         terminated = await self._scale_down(lm)
         n_demands = len(lm["pending_demands"]) + \
             len(lm["pending_placement_groups"])
-        if launched or terminated or self._unsatisfied:
+        if launched or terminated or preempted or self._unsatisfied:
             rec = {"ts": time.time(), "demands": n_demands,
                    "launched": list(launched),
                    "terminated": list(terminated),
+                   "preempted": list(preempted),
                    "unsatisfied": list(self._unsatisfied)}
             self.decisions.append(rec)
             try:
@@ -133,7 +135,30 @@ class StandardAutoscaler:
                                        rec)
             except RpcError:
                 pass
-        return {"launched": launched, "terminated": terminated}
+        return {"launched": launched, "terminated": terminated,
+                "preempted": preempted}
+
+    async def _reap_preempted(self) -> List[str]:
+        """Providers that can observe cloud-side preemption (GCP spot
+        TPUs report PREEMPTED/TERMINATED) expose ``reap_preempted``:
+        untracking a preempted node drops the type's live count below
+        its target, so the normal demand/min_workers pass RELAUNCHES a
+        replacement this same tick instead of treating the loss as
+        terminal.  The reap is recorded in the decision ring."""
+        reap = getattr(self.provider, "reap_preempted", None)
+        if reap is None:
+            return []
+        try:
+            gone = await asyncio.get_event_loop().run_in_executor(
+                None, reap)
+        except Exception:  # noqa: BLE001 — a cloud hiccup must not
+            logger.exception("preemption reap failed")  # kill the loop
+            return []
+        for pid in gone:
+            self._launch_times.pop(pid, None)
+            logger.warning("node %s was preempted; replacement counts "
+                           "against its type's target", pid)
+        return list(gone)
 
     def _counts_by_type(self) -> Dict[str, int]:
         counts: Dict[str, int] = {}
@@ -161,8 +186,13 @@ class StandardAutoscaler:
 
         # Capacity that can still absorb demand: live nodes' available
         # plus nodes launched but not yet registered (full resources).
+        # Draining nodes are NOT capacity — they refuse new leases and
+        # will be gone by their deadline (their replacement demand
+        # arrives through pending_demands, so bin-packing launches the
+        # substitute during the grace window).
         capacity: List[Dict[str, float]] = [
-            dict(info["available"]) for info in lm["nodes"].values()]
+            dict(info["available"]) for info in lm["nodes"].values()
+            if not info.get("draining")]
         for pid in self.provider.non_terminated_nodes():
             nid = self.provider.node_cluster_id(pid)
             if nid is not None and nid not in lm["nodes"]:
@@ -228,6 +258,11 @@ class StandardAutoscaler:
                 continue
             nid = self.provider.node_cluster_id(pid)
             info = lm["nodes"].get(nid)
+            if info is not None and info.get("draining"):
+                # Mid-drain nodes die on their own schedule (and their
+                # replacement is already launching); idle-reaping one
+                # would race the checkpoint-on-notice window.
+                continue
             if info is None:
                 # Not registered yet: give it launch grace, then treat a
                 # silent node as dead and reap it.

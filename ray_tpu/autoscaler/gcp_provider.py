@@ -97,8 +97,17 @@ class GcpTpuApi:
         return self._request("GET", f"{self.parent}/nodes/{node_id}")
 
     def list_nodes(self) -> List[Dict]:
-        return self._request("GET",
-                             f"{self.parent}/nodes").get("nodes", [])
+        out: List[Dict] = []
+        token = ""
+        while True:
+            path = f"{self.parent}/nodes"
+            if token:
+                path += f"?pageToken={token}"
+            r = self._request("GET", path)
+            out.extend(r.get("nodes", []))
+            token = r.get("nextPageToken") or ""
+            if not token:
+                return out
 
     def delete_node(self, node_id: str) -> Dict:
         return self._request("DELETE",
@@ -330,6 +339,46 @@ class GCPTpuNodeProvider(RemoteNodeProvider):
             self._delete_cloud_node(name)
             deleted.append(name)
         return deleted
+
+    def reap_preempted(self) -> List[str]:
+        """Untrack nodes the cloud reports PREEMPTED/TERMINATED so the
+        autoscaler relaunches replacements against the type's target
+        instead of treating spot loss as terminal (the dominant
+        failure on preemptible TPU fleets is an announced VM death,
+        not a crash).  The dead cloud resource is deleted — a
+        PREEMPTED TPU node still occupies its name (and, queued, its
+        QR) until deleted, which would 409 the replacement."""
+        try:
+            states = {
+                (n.get("nodeId")
+                 or (n.get("name") or "").rsplit("/", 1)[-1]):
+                    n.get("state")
+                for n in self.api.list_nodes()}
+        except Exception:
+            logger.warning("list_nodes failed during preemption scan",
+                           exc_info=True)
+            return []
+        reaped = []
+        with self._lock:
+            tracked = list(self._nodes)
+        for pid in tracked:
+            # Only EXPLICIT terminal states reap.  A node merely
+            # missing from the listing is unknown — a transient or
+            # truncated 200 must not kill healthy local pids and
+            # untrack live paid capacity.
+            state = states.get(pid)
+            if state not in ("PREEMPTED", "TERMINATED"):
+                continue
+            with self._lock:
+                node = self._nodes.pop(pid, None)
+            if node is None:
+                continue
+            logger.warning("TPU node %s is %s; reaping for "
+                           "replacement", pid, state)
+            self._kill_node_pids(node)
+            self._delete_cloud_node(pid)
+            reaped.append(pid)
+        return reaped
 
     def terminate_node(self, provider_id: str) -> None:
         with self._lock:

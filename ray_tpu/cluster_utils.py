@@ -56,6 +56,21 @@ class Cluster:
         self.nodes.append(handle)
         return handle
 
+    def preempt_node(self, node: NodeHandle,
+                     grace_s: float = 3.0) -> None:
+        """Preempt a node the way GCP does: SIGTERM (the preemption
+        notice — the agent enters DRAINING, training gangs get the
+        interruption flag and checkpoint-on-notice), then after
+        ``grace_s`` the agent AND its workers are SIGKILLed like the
+        VM vanishing.  Blocks for the grace window."""
+        from .testing.chaos import preempt_node_processes
+
+        preempt_node_processes(node, grace_s)
+        try:
+            self.nodes.remove(node)
+        except ValueError:
+            pass
+
     def remove_node(self, node: NodeHandle, *,
                     allow_graceful: bool = False) -> None:
         """Kill a node agent (and its workers), simulating node failure."""

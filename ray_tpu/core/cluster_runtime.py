@@ -2522,7 +2522,10 @@ class ClusterRuntime(BaseRuntime):
         nodes = self.io.run(self._ctl.call("list_nodes", {}))
         total: Dict[str, float] = {}
         for n in nodes:
-            if n["alive"]:
+            if n["alive"] and not n.get("draining"):
+                # A draining node's capacity is leaving the cluster:
+                # elastic gang sizing (ElasticScalingPolicy) must not
+                # count chips that will be gone by the next attempt.
                 for k, v in n["available"].items():
                     total[k] = total.get(k, 0.0) + v
         return total
@@ -2533,7 +2536,10 @@ class ClusterRuntime(BaseRuntime):
             out.append({
                 "NodeID": n["node_id"].hex(), "Alive": n["alive"],
                 "Resources": n["resources"], "AgentAddress": n["agent_addr"],
-                "Labels": n["labels"], "IsHead": n.get("is_head", False)})
+                "Labels": n["labels"], "IsHead": n.get("is_head", False),
+                "Draining": n.get("draining", False),
+                "DrainDeadline": n.get("drain_deadline", 0.0),
+                "DrainReason": n.get("drain_reason", "")})
         return out
 
     def controller_call(self, method: str, payload=None, timeout=None):

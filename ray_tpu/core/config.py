@@ -191,6 +191,25 @@ define_flag("stuck_task_p99_factor", float, 3.0,
             "Stuck-task detector multiplier: a RUNNING task is "
             "flagged once its age exceeds factor x the historical p99 "
             "duration of same-named finished tasks (and the floor).")
+define_flag("preemption_grace_s", float, 30.0,
+            "Drain window granted on a preemption notice (SIGTERM / "
+            "`rt drain`): the node agent stops accepting leases, "
+            "reports a drain deadline this far in the future, and the "
+            "training plane races a checkpoint-on-notice against it "
+            "(GCP spot TPUs deliver ~30s between notice and VM "
+            "death).")
+define_flag("restart_backoff_base_s", float, 1.0,
+            "First inter-attempt delay of the train controller's "
+            "jittered exponential restart backoff (0 disables "
+            "backoff — the pre-drain-plane hot-loop retry).")
+define_flag("restart_backoff_max_s", float, 60.0,
+            "Ceiling on the train restart backoff delay.")
+define_flag("restart_backoff_multiplier", float, 2.0,
+            "Growth factor between consecutive restart delays.")
+define_flag("restart_backoff_jitter", float, 0.2,
+            "Fractional jitter on each restart delay (0.2 = +/-20%), "
+            "decorrelating gang restarts across drivers after a "
+            "fleet-wide preemption wave.")
 define_flag("straggler_threshold", float, 0.2,
             "Straggler detector: a rank whose step time exceeds the "
             "per-step median by this fraction, sustained over the "

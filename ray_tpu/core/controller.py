@@ -58,6 +58,14 @@ class NodeEntry:
     is_head: bool = False
     idle_s: float = 0.0                 # autoscaler: node idle duration
     pending_demands: List = field(default_factory=list)
+    # Drain plane: set by node_draining / drain_node and refreshed by
+    # the agent's heartbeat; drives lease-avoidance (resource_view),
+    # the autoscaler's proactive replacement, and doctor's stale-drain
+    # check.
+    draining: bool = False
+    drain_deadline: float = 0.0
+    drain_reason: str = ""
+    drain_replace: bool = True
 
 
 @dataclass
@@ -144,6 +152,7 @@ class Controller:
             "create_placement_group", "remove_placement_group",
             "get_placement_group", "list_placement_groups",
             "list_actors", "cluster_shutdown", "ping", "drain_node",
+            "node_draining",
             "task_events", "list_tasks", "get_task", "list_objects",
             "list_jobs", "report_metrics", "metrics_text",
             "metrics_history", "get_load_metrics", "worker_logs",
@@ -176,7 +185,15 @@ class Controller:
             return None
         cli = self._agent_clients.get(node_id)
         if cli is None or not cli.connected:
-            cli = RpcClient(node.agent_addr, tag=f"controller->{node_id.hex()[:8]}")
+            # Short dial window: these are same-DC control-plane dials
+            # to agents that already registered.  The default 30s
+            # retry loop means every RPC aimed at a just-died (but not
+            # yet marked dead) node — kill_actor during a gang
+            # teardown, drain_node during a preemption wave — wedges
+            # its caller for half a minute.
+            cli = RpcClient(node.agent_addr,
+                            tag=f"controller->{node_id.hex()[:8]}",
+                            connect_timeout=3.0)
             try:
                 await cli.connect()
             except RpcError:
@@ -210,6 +227,22 @@ class Controller:
             node.resources_total = p["total"]
         node.idle_s = p.get("idle_s", 0.0)
         node.pending_demands = p.get("pending_demands", [])
+        if p.get("draining"):
+            # The agent's own view is authoritative once it drains;
+            # a heartbeat that predates a drain_node RPC must NOT
+            # clear controller-marked drain state (drains are one-way
+            # until the node dies).  The deadline arrives as REMAINING
+            # seconds and is re-anchored to the controller clock here
+            # — the stale-drain check compares against this clock, and
+            # agent wall time can be arbitrarily skewed.
+            node.draining = True
+            remaining = p.get("drain_remaining_s")
+            if remaining is not None:
+                node.drain_deadline = time.time() + float(remaining)
+            else:
+                node.drain_deadline = p.get("drain_deadline", 0.0)
+            node.drain_reason = p.get("drain_reason", "")
+            node.drain_replace = p.get("drain_replace", True)
         return {"ok": True}
 
     async def get_load_metrics(self, _p):
@@ -226,8 +259,18 @@ class Controller:
                 "idle_s": getattr(n, "idle_s", 0.0),
                 "is_head": n.is_head,
                 "agent_addr": n.agent_addr,
+                "draining": n.draining,
+                "drain_deadline": n.drain_deadline,
             }
             demands.extend(getattr(n, "pending_demands", []))
+            if n.draining and n.drain_replace:
+                # Proactive replacement: a draining node's capacity is
+                # leaving the cluster — advertise its full shape as
+                # demand NOW so the autoscaler starts a replacement
+                # during the grace window instead of after the death
+                # (idle-timeout drains pass replace=False; replacing a
+                # node the scaler itself is reaping would thrash).
+                demands.append(dict(n.resources_total))
         pg_demands = []
         if self._placement is not None:
             for entry in self._placement._groups.values():
@@ -242,31 +285,120 @@ class Controller:
             {"node_id": n.node_id, "agent_addr": n.agent_addr,
              "alive": n.alive, "resources": n.resources_total,
              "available": n.resources_available, "labels": n.labels,
-             "is_head": n.is_head}
+             "is_head": n.is_head, "draining": n.draining,
+             "drain_deadline": n.drain_deadline,
+             "drain_reason": n.drain_reason}
             for n in self.nodes.values()
         ]
 
     async def resource_view(self, _p):
-        """Scheduling snapshot used by agents for spillback decisions."""
+        """Scheduling snapshot used by agents for spillback decisions.
+        Draining nodes are excluded — spilling work onto a node about
+        to die just converts an announced failure into a surprise
+        one."""
         return {
             n.node_id: {"available": n.resources_available,
                         "total": n.resources_total,
                         "agent_addr": n.agent_addr}
-            for n in self.nodes.values() if n.alive
+            for n in self.nodes.values() if n.alive and not n.draining
         }
 
+    def _resolve_node(self, ref) -> Optional[NodeEntry]:
+        """Resolve a node by NodeID or hex prefix (CLI convenience)."""
+        node = self.nodes.get(ref)
+        if node is not None:
+            return node
+        if isinstance(ref, str) and ref:
+            matches = [n for nid, n in self.nodes.items()
+                       if nid.hex().startswith(ref)]
+            if len(matches) == 1:
+                return matches[0]
+        return None
+
     async def drain_node(self, p):
+        """Drain a node (operator `rt drain <node>` or the autoscaler's
+        if_idle reap): marks the controller's node row immediately and
+        forwards the drain to the agent, which stops granting leases
+        and redirects its queue.  ``node_id`` may be a NodeID or a hex
+        prefix."""
+        node = self._resolve_node(p.get("node_id"))
+        if node is None:
+            return {"ok": False, "error": "unknown node"}
+        if_idle = p.get("if_idle", False)
+        reason = p.get("reason") or (
+            "idle timeout" if if_idle else "operator drain")
+        grace_s = p.get("grace_s") or 0.0
+        r = None
+        cli = await self._agent(node.node_id)
+        if cli is not None:
+            try:
+                r = await cli.call("drain", {
+                    "if_idle": if_idle, "reason": reason,
+                    "grace_s": grace_s or None,
+                    "replace": p.get("replace", not if_idle)})
+            except RpcError:
+                r = None
+        if r is None:
+            # The agent never acknowledged: marking the row anyway
+            # would split-brain — the agent keeps granting leases
+            # while the controller excludes it, advertises phantom
+            # replacement demand, and (drains being one-way) nothing
+            # ever reconciles.  Fail the drain; the operator retries.
+            return {"ok": False,
+                    "error": "agent unreachable; node NOT drained"}
+        if not r.get("ok"):
+            return r  # agent refused (if_idle race) — stay undrained
+        # Mark the row NOW — the agent's heartbeat confirms within a
+        # period, but callers (doctor, the trainer's drain poll) must
+        # see the state immediately.  The agent's own node_draining
+        # callback usually beat us here (fired inside its drain
+        # handler); the hooks run once either way.
+        first = not node.draining
+        node.draining = True
+        node.drain_reason = reason
+        remaining = r.get("remaining_s") or grace_s or \
+            self.config.preemption_grace_s
+        node.drain_deadline = time.time() + remaining
+        node.drain_replace = p.get("replace", not if_idle)
+        if first:
+            await self._on_node_draining(node)
+        return {"ok": True, "draining": True,
+                "node_id": node.node_id.hex(),
+                "deadline": node.drain_deadline}
+
+    async def node_draining(self, p):
+        """Agent-initiated drain notice (SIGTERM / preemption signal):
+        mark the row and kick the migration hooks without waiting for
+        the next heartbeat — the grace window can be seconds."""
         node = self.nodes.get(p["node_id"])
         if node is None:
             return {"ok": False}
-        cli = await self._agent(p["node_id"])
-        if cli is not None:
-            try:
-                return await cli.call(
-                    "drain", {"if_idle": p.get("if_idle", False)})
-            except RpcError:
-                pass
+        first = not node.draining
+        node.draining = True
+        node.drain_reason = p.get("reason", "")
+        remaining = p.get("remaining_s")
+        node.drain_deadline = (time.time() + float(remaining)
+                               if remaining is not None
+                               else p.get("deadline", 0.0))
+        node.drain_replace = p.get("replace", True)
+        if first:
+            await self._on_node_draining(node)
         return {"ok": True}
+
+    async def _on_node_draining(self, node: NodeEntry) -> None:
+        logger.warning("node %s DRAINING (%s), deadline %s",
+                       node.node_id.hex()[:8], node.drain_reason,
+                       node.drain_deadline)
+        self._publish("node", {"node_id": node.node_id,
+                               "state": "DRAINING",
+                               "reason": node.drain_reason,
+                               "deadline": node.drain_deadline})
+        # Placement groups with bundles on the node are marked for
+        # migration (rescheduling happens on death — yanking bundles
+        # out from under a live gang would kill the very training run
+        # the drain window exists to checkpoint).
+        if self._placement is not None:
+            self._placement.on_node_draining(node.node_id)
 
     async def _health_loop(self) -> None:
         period = self.config.raylet_heartbeat_period_ms / 1000.0
@@ -886,6 +1018,7 @@ class Controller:
             "demands": p.get("demands", 0),
             "launched": list(p.get("launched") or []),
             "terminated": list(p.get("terminated") or []),
+            "preempted": list(p.get("preempted") or []),
             "unsatisfied": list(p.get("unsatisfied") or [])})
         return {"ok": True}
 

@@ -144,7 +144,15 @@ class NodeAgent:
         self._ctl: Optional[RpcClient] = None
         self._peer_agents: Dict[str, RpcClient] = {}
         self._resource_view: Dict[Any, Dict] = {}
+        # Drain lifecycle (preemption notice / `rt drain`): a draining
+        # agent refuses new lease grants, redirects its queued lease
+        # requests to live peers, and advertises the drain deadline in
+        # its heartbeat so the controller/autoscaler can migrate work
+        # and start a replacement BEFORE the node dies.
         self._draining = False
+        self._drain_reason = ""
+        self._drain_deadline = 0.0
+        self._drain_replace = True
         # Lease-ledger view state (`rt list leases` / `rt doctor`):
         # owner-reported pipeline depth per lease, when an owner tag's
         # connection was first seen lost, and per-lease disconnect
@@ -227,6 +235,49 @@ class NodeAgent:
                 signal.SIGUSR2, _dump_tasks)
         except (NotImplementedError, RuntimeError):
             pass
+        # Preemption notice: GCP delivers SIGTERM seconds-to-minutes
+        # before a spot VM dies.  Enter DRAINING instead of dying so
+        # the grace window is spent migrating work (checkpoint-on-
+        # notice, queued-lease redirect) rather than lost.  A REPEATED
+        # SIGTERM forces immediate shutdown (operator escape hatch) —
+        # but only once a SIGTERM already armed the deadline: the
+        # first SIGTERM on a node mid `rt drain` is the real cloud
+        # notice, and discarding its grace would kill gangs mid
+        # checkpoint-on-notice.
+        def _on_sigterm():
+            if getattr(self, "_sigterm_drained", False):
+                spawn_task(self.shutdown())
+            elif self._draining:
+                self._sigterm_drained = True
+                now = time.time()
+                grace = self.config.preemption_grace_s
+                if self._drain_deadline > 0:
+                    self._drain_deadline = min(self._drain_deadline,
+                                               now + grace)
+                else:
+                    self._drain_deadline = now + grace
+                asyncio.get_event_loop().call_later(
+                    max(self._drain_deadline - now, 0.0),
+                    lambda: spawn_task(self.shutdown()))
+            elif not self.leases and not self.pending \
+                    and not self.bundles:
+                # Nothing to migrate: spending the grace window on an
+                # idle node only slows down `rt stop` / graceful
+                # teardown paths that relied on SIGTERM exiting.
+                self._sigterm_drained = True
+                spawn_task(self.shutdown())
+            else:
+                self._sigterm_drained = True
+                spawn_task(self._begin_drain(
+                    reason="preemption notice (SIGTERM)",
+                    grace_s=self.config.preemption_grace_s,
+                    shutdown_at_deadline=True))
+
+        try:
+            asyncio.get_event_loop().add_signal_handler(
+                signal.SIGTERM, _on_sigterm)
+        except (NotImplementedError, RuntimeError):
+            pass
         await self.server.start(port)
         # Evictions from ANY shed site (read-window expiry, restore
         # pressure, register) must drop their controller locations, or
@@ -294,7 +345,19 @@ class NodeAgent:
                     # Autoscaler inputs (ref: ray_syncer.proto:31-47
                     # idle_duration_ms + LoadMetrics demand vector).
                     "idle_s": now - self._last_busy,
-                    "pending_demands": demands})
+                    "pending_demands": demands,
+                    # Drain plane: the controller mirrors these into
+                    # its node table (`rt drain` state, doctor's
+                    # stale-drain check, autoscaler replacement).
+                    # The deadline crosses hosts as REMAINING seconds
+                    # — agent wall clocks can sit minutes off the
+                    # controller's, and the stale-drain check compares
+                    # against the controller clock (same receipt-clock
+                    # discipline as flight-dump ages).
+                    "draining": self._draining,
+                    "drain_remaining_s": self._drain_remaining(),
+                    "drain_reason": self._drain_reason,
+                    "drain_replace": self._drain_replace})
                 now = time.time()
                 if now - last_metrics >= \
                         self.config.metrics_report_period_s:
@@ -820,6 +883,11 @@ class NodeAgent:
         return None
 
     async def _try_grant(self, payload) -> Optional[Dict]:
+        # A draining node grants NOTHING — not even queued requests
+        # that predate the drain (they are redirected by _begin_drain)
+        # or actor restarts (the controller retries on a live node).
+        if self._draining:
+            return None
         # Reserve resources synchronously (no awaits) so concurrent grant
         # attempts can't double-spend, then await a worker and refund on
         # failure.
@@ -902,6 +970,15 @@ class NodeAgent:
         node_manager.cc:1867 HandleRequestWorkerLease +
         hybrid_scheduling_policy.h)."""
         if self._draining:
+            # Redirect new work to a live peer when the placement
+            # allows it; affinity/PG-bound leases cannot move, so they
+            # fail fast and the owner's retry machinery deals with it.
+            if p.get("pg_id") is None and not p.get("no_spill"):
+                target = await self._pick_remote(
+                    ResourceSet(dict(p["resources"])),
+                    p.get("strategy", "DEFAULT"), by_total=True)
+                if target is not None:
+                    return {"ok": False, "retry_at": target}
             return {"ok": False, "error": "node draining"}
         granted = await self._try_grant(p)
         if granted is not None:
@@ -1000,6 +1077,7 @@ class NodeAgent:
         queue rather than reject."""
         local_util = self.available.utilization(self.total)
         if not by_total and strategy == "DEFAULT" and \
+                not self._draining and \
                 local_util < self.config.scheduler_spread_threshold \
                 and self.total.covers(demand):
             return None  # queue locally; we're not saturated
@@ -1022,7 +1100,11 @@ class NodeAgent:
         if strategy == "SPREAD":
             return candidates[0][2]
         # DEFAULT: only spill if we cannot serve now and someone can.
-        if not self.available.covers(demand):
+        # A DRAINING node can never serve — its free capacity is a
+        # mirage (grants are refused), so the redirect must fire even
+        # when available covers the demand, or a lightly-loaded
+        # draining node hard-fails every request aimed at it.
+        if self._draining or not self.available.covers(demand):
             return candidates[0][2]
         return None
 
@@ -1044,6 +1126,16 @@ class NodeAgent:
                 bundle.in_use = bundle.in_use.subtract(lease.resources)
             except ValueError:
                 bundle.in_use = ResourceSet()
+            if lease.blocked:
+                # Undo the node-pool CPU credited at block time: the
+                # bundle accounting above is the only release a PG
+                # lease gets, so the credit would otherwise leak
+                # phantom CPU into the pool forever.
+                part = self._blockable_part(lease.resources)
+                self.available = ResourceSet({
+                    **self.available.amounts,
+                    "CPU": self.available.get("CPU")
+                    - part.get("CPU")})
         elif lease.blocked:
             # CPU was already re-credited at block time; return the rest.
             rest = lease.resources.subtract(
@@ -1330,14 +1422,18 @@ class NodeAgent:
     async def task_blocked(self, p):
         """A worker blocked in get(): return its CPU so nested tasks can
         schedule (ref: the reference releases CPU for blocked workers in
-        local_task_manager)."""
+        local_task_manager).  PG-bound leases credit the NODE pool too:
+        a gang whose placement group covers the whole node would
+        otherwise starve every non-PG lease forever — e.g. a training
+        gang blocked pushing to a result-queue actor that can never
+        schedule (the reference likewise releases blocked workers' CPU
+        regardless of placement-group binding)."""
         lease = self.leases.get(p["lease_id"])
         if lease is not None and not lease.blocked:
             lease.blocked = True
-            if lease.pg_id is None:
-                self.available = self.available.add(
-                    self._blockable_part(lease.resources))
-                self._clamp_available()
+            self.available = self.available.add(
+                self._blockable_part(lease.resources))
+            self._clamp_available()
             self._kick_scheduler()
         return {"ok": True}
 
@@ -1345,12 +1441,11 @@ class NodeAgent:
         lease = self.leases.get(p["lease_id"])
         if lease is not None and lease.blocked:
             lease.blocked = False
-            if lease.pg_id is None:
-                # May oversubscribe briefly; clamped in heartbeat view.
-                part = self._blockable_part(lease.resources)
-                self.available = ResourceSet({
-                    **self.available.amounts,
-                    "CPU": self.available.get("CPU") - part.get("CPU")})
+            # May oversubscribe briefly; clamped in heartbeat view.
+            part = self._blockable_part(lease.resources)
+            self.available = ResourceSet({
+                **self.available.amounts,
+                "CPU": self.available.get("CPU") - part.get("CPU")})
         return {"ok": True}
 
     # -------------------------------------------------------- object plane
@@ -1710,15 +1805,87 @@ class NodeAgent:
 
     # -------------------------------------------------------------- admin
     async def drain(self, p=None):
-        """Stop accepting leases.  ``if_idle`` (the autoscaler's mode)
-        refuses when leases are active, closing the race where a task is
-        granted between the idle observation and the terminate (ref:
-        DrainRaylet rejection path, node_manager.proto:407)."""
-        if p and p.get("if_idle") and (self.leases or self.pending):
+        """Enter the DRAINING lifecycle state (operator `rt drain`,
+        controller drain_node, or the autoscaler's idle reap).
+        ``if_idle`` (the autoscaler's mode) refuses when leases are
+        active, closing the race where a task is granted between the
+        idle observation and the terminate (ref: DrainRaylet rejection
+        path, node_manager.proto:407)."""
+        p = p or {}
+        if p.get("if_idle") and (self.leases or self.pending):
             return {"ok": False, "busy": True,
                     "leases": len(self.leases)}
+        await self._begin_drain(
+            reason=p.get("reason") or "drain requested",
+            grace_s=p.get("grace_s") or self.config.preemption_grace_s,
+            replace=p.get("replace", not p.get("if_idle", False)))
+        return {"ok": True, "draining": True,
+                "deadline": self._drain_deadline,
+                "remaining_s": self._drain_remaining(),
+                "node_id": self.node_id.hex()}
+
+    def _drain_remaining(self) -> float:
+        """Grace left before this node's drain deadline, in THIS
+        host's clock-free terms — the form the deadline crosses hosts
+        in (the receiver re-anchors it to its own clock)."""
+        if not self._draining or not self._drain_deadline:
+            return 0.0
+        return max(self._drain_deadline - time.time(), 0.0)
+
+    async def _begin_drain(self, reason: str, grace_s: float,
+                           replace: bool = True,
+                           shutdown_at_deadline: bool = False) -> None:
+        """The drain state machine's single entry point: stop granting,
+        stamp the deadline, redirect queued lease requests to live
+        peers, and notify the controller immediately (the heartbeat
+        would carry it anyway, but the grace window can be seconds —
+        every one counts for the checkpoint-on-notice race)."""
+        if self._draining:
+            return  # already draining; first deadline stands
         self._draining = True
-        return {"ok": True}
+        self._drain_reason = reason
+        self._drain_deadline = time.time() + max(grace_s, 0.0)
+        self._drain_replace = replace
+        logger.warning("node DRAINING (%s): deadline in %.1fs, "
+                       "%d lease(s) held, %d queued request(s)",
+                       reason, grace_s, len(self.leases),
+                       len(self.pending))
+        if shutdown_at_deadline:
+            # Preemption-notice drains mirror the real failure: the VM
+            # dies at the deadline whether or not we are ready.
+            asyncio.get_event_loop().call_later(
+                max(grace_s, 0.0), lambda: spawn_task(self.shutdown()))
+        # Proactively requeue queued work: resolve each pending lease
+        # request with a redirect to a peer that could ever host it,
+        # so owners re-request there instead of queueing into a node
+        # about to die.  Placement-bound requests stay queued (they
+        # cannot move; the controller reschedules the group on death).
+        for req in list(self.pending):
+            if req.future.done():
+                continue
+            payload = req.payload
+            if payload.get("pg_id") is not None or \
+                    payload.get("no_spill"):
+                continue
+            target = await self._pick_remote(
+                ResourceSet(dict(payload["resources"])),
+                payload.get("strategy", "DEFAULT"), by_total=True)
+            if target is not None and not req.future.done():
+                req.future.set_result({"ok": False, "retry_at": target})
+                try:
+                    self.pending.remove(req)
+                except ValueError:
+                    pass
+        if self._ctl is None:
+            return  # SIGTERM before registration: nothing to migrate
+        try:
+            await self._ctl.call("node_draining", {
+                "node_id": self.node_id, "reason": reason,
+                "deadline": self._drain_deadline,
+                "remaining_s": self._drain_remaining(),
+                "replace": replace})
+        except RpcError:
+            pass  # heartbeat mirrors the state within a period
 
     async def ping(self, _p):
         return {"ok": True, "node_id": self.node_id}
@@ -1899,7 +2066,10 @@ class NodeAgent:
                 "total": dict(self.total.amounts),
                 "available": dict(self.available.amounts),
                 "workers": len(self.workers),
-                "leases": len(self.leases)}
+                "leases": len(self.leases),
+                "draining": self._draining,
+                "drain_deadline": self._drain_deadline,
+                "drain_reason": self._drain_reason}
 
     async def shutdown(self, _p=None):
         self._shutdown.set()

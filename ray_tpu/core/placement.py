@@ -42,6 +42,10 @@ class PGEntry:
     placement: Dict[int, NodeID] = field(default_factory=dict)
     create_time: float = field(default_factory=time.time)
     waiters: List[asyncio.Event] = field(default_factory=list)
+    # Drain plane: a node hosting one of our bundles is DRAINING —
+    # this group will need rescheduling when it dies (surfaced in
+    # get()/list so operators see which gangs a drain will move).
+    migrate_pending: bool = False
 
 
 def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
@@ -62,7 +66,8 @@ class PlacementGroupManager:
     def _plan(self, entry: PGEntry) -> Optional[Dict[int, NodeID]]:
         """Bin-pack bundles onto alive nodes per strategy (ref:
         BundleSchedulingPolicy in src/ray/raylet/scheduling/policy/)."""
-        nodes = [n for n in self._ctl.nodes.values() if n.alive]
+        nodes = [n for n in self._ctl.nodes.values()
+                 if n.alive and not getattr(n, "draining", False)]
         if not nodes:
             return None
         avail = {n.node_id: dict(n.resources_available) for n in nodes}
@@ -218,10 +223,25 @@ class PlacementGroupManager:
         }
         return {"pg_id": entry.pg_id, "state": entry.state,
                 "bundles": entry.bundles, "strategy": entry.strategy,
-                "placement": placement, "name": entry.name}
+                "placement": placement, "name": entry.name,
+                "migrate_pending": entry.migrate_pending}
 
     def list_all(self, _p):
         return [self.get({"pg_id": pid}) for pid in self._groups]
+
+    def on_node_draining(self, node_id: NodeID) -> None:
+        """Mark groups with bundles on a draining node for migration.
+        Rescheduling itself waits for the node's death — bundles must
+        not be yanked from under the live gang that is spending the
+        grace window on a checkpoint-on-notice."""
+        for entry in self._groups.values():
+            if entry.state == CREATED and \
+                    node_id in entry.placement.values() and \
+                    not entry.migrate_pending:
+                entry.migrate_pending = True
+                self._ctl._publish("placement_group", {
+                    "pg_id": entry.pg_id, "state": entry.state,
+                    "migrate_pending": True})
 
     async def on_node_dead(self, node_id: NodeID) -> None:
         for entry in self._groups.values():
@@ -240,6 +260,7 @@ class PlacementGroupManager:
                         except RpcError:
                             pass
                 entry.placement = {}
+                entry.migrate_pending = False  # migration underway
                 self._ctl._publish("placement_group",
                                    {"pg_id": entry.pg_id,
                                     "state": RESCHEDULING})

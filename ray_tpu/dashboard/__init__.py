@@ -51,6 +51,7 @@ async function refresh() {
     grab("/api/tasks?limit=50"), grab("/api/jobs")]);
   document.getElementById("root").innerHTML =
     "<h2>Nodes</h2>" + table(nodes, ["node_id", "agent_addr", "alive",
+                                     "draining", "drain_reason",
                                      "is_head", "resources",
                                      "available"]) +
     "<h2>Actors</h2>" + table(actors, ["actor_id", "class_name",

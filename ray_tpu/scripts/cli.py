@@ -243,6 +243,8 @@ def cmd_status(args) -> int:
     print(f"Nodes: {len(alive)} alive / {len(nodes)} total")
     for n in nodes:
         state = "ALIVE" if n["alive"] else "DEAD "
+        if n["alive"] and n.get("draining"):
+            state = "DRAIN"
         head = " (head)" if n.get("is_head") else ""
         res = ", ".join(f"{k}={v:g}" for k, v in
                         sorted(n.get("resources", {}).items()))
@@ -442,6 +444,42 @@ def cmd_doctor(args) -> int:
     critical = any(f.get("severity") == "critical"
                    for f in diag.get("findings", []))
     return 1 if critical else 0
+
+
+def cmd_drain(args) -> int:
+    """Gracefully drain a node (the operator's preemption notice): the
+    agent stops accepting leases, queued work is redirected to live
+    peers, training gangs on the node see ``train.interrupted()`` and
+    checkpoint-on-notice, and the autoscaler starts a replacement —
+    all before the node actually goes away."""
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    payload = {"node_id": args.node, "reason": args.reason,
+               "if_idle": args.if_idle}
+    if args.grace > 0:
+        payload["grace_s"] = args.grace
+    r = _call(address, "drain_node", payload)
+    if not r.get("ok"):
+        if r.get("busy"):
+            print(f"not drained: node is busy "
+                  f"({r.get('leases', '?')} active lease(s)); "
+                  f"drop --if-idle to drain anyway", file=sys.stderr)
+        else:
+            print(f"error: {r.get('error', 'drain failed')}",
+                  file=sys.stderr)
+        return 1
+    import datetime
+
+    deadline = r.get("deadline") or 0.0
+    when = datetime.datetime.fromtimestamp(deadline).strftime(
+        "%H:%M:%S") if deadline else "?"
+    print(f"node {r.get('node_id', args.node)[:12]} is DRAINING "
+          f"(deadline {when}, {max(deadline - time.time(), 0):.0f}s "
+          f"of grace)")
+    print("watch it with: rt doctor; rt status")
+    return 0
 
 
 def cmd_explain(args) -> int:
@@ -805,6 +843,21 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--format", choices=["text", "json"],
                     default="text")
     sp.set_defaults(fn=cmd_doctor)
+
+    sp = sub.add_parser("drain",
+                        help="gracefully drain a node (stop leases, "
+                             "checkpoint-on-notice, start a "
+                             "replacement) before it goes away")
+    sp.add_argument("node", help="node id (hex prefix ok)")
+    sp.add_argument("--reason", default="operator drain")
+    sp.add_argument("--grace", type=float, default=0.0,
+                    help="drain deadline seconds from now (default: "
+                         "RT_PREEMPTION_GRACE_S)")
+    sp.add_argument("--if-idle", action="store_true",
+                    help="refuse if the node holds leases or queued "
+                         "work (the autoscaler's mode)")
+    sp.add_argument("--address", default="")
+    sp.set_defaults(fn=cmd_drain)
 
     sp = sub.add_parser("explain",
                         help="scheduling transition chain of one "

@@ -5,4 +5,5 @@ python/ray/_private/test_utils.py:1511 ResourceKillerActor /
 NodeKillerBase / WorkerKillerActor).
 """
 
-from .chaos import NodeKiller, WorkerKiller  # noqa
+from .chaos import (NodeKiller, PreemptionKiller,  # noqa
+                    WorkerKiller, preempt_node_processes)

@@ -47,10 +47,13 @@ class _KillerThread:
             if pid is None:
                 continue
             try:
-                os.kill(pid, signal.SIGKILL)
+                self._kill(pid)
                 self.kills.append(pid)
             except (ProcessLookupError, PermissionError):
                 pass
+
+    def _kill(self, pid: int) -> None:
+        os.kill(pid, signal.SIGKILL)
 
     def _pick(self) -> Optional[int]:  # pragma: no cover - abstract
         raise NotImplementedError
@@ -75,6 +78,86 @@ class NodeKiller(_KillerThread):
             return None
         victim = self._rng.choice(live)
         return victim.proc.pid
+
+
+class PreemptionKiller(_KillerThread):
+    """Mirrors a real GCP spot preemption: the victim node agent gets
+    SIGTERM (the preemption notice — it enters DRAINING and the
+    training plane races a checkpoint-on-notice), then after the
+    configured grace the whole node dies hard — SIGKILL to the agent
+    AND every worker process it hosts, like the VM vanishing (ref:
+    NodeKillerBase, plus the GCP preemption-notice semantics the
+    drain plane exists for)."""
+
+    def __init__(self, cluster, interval_s: float = 10.0,
+                 grace_s: float = 3.0, seed: int = 0,
+                 spare_head: bool = True, max_kills: int = 0):
+        super().__init__(interval_s, seed, max_kills)
+        self._cluster = cluster
+        self._grace = grace_s
+        self._spare_head = spare_head
+
+    def _pick(self):
+        nodes = list(self._cluster.nodes)
+        if self._spare_head and nodes:
+            nodes = nodes[1:]
+        live = [n for n in nodes if n.proc.poll() is None]
+        if not live:
+            return None
+        return self._rng.choice(live)
+
+    def _kill(self, node) -> None:
+        preempt_node_processes(node, self._grace,
+                               stop_event=self._stop)
+
+
+def _agent_worker_pids(agent_addr: str) -> List[int]:
+    """Worker pids of a (single-machine test) node agent, via its
+    list_workers RPC — the processes a real VM death would take out
+    along with the agent."""
+    import asyncio
+
+    from ..core.rpc import RpcClient
+
+    async def _go():
+        cli = RpcClient(agent_addr, connect_timeout=5.0)
+        try:
+            r = await cli.call("list_workers", {})
+            return [w["pid"] for w in r.get("workers", [])]
+        finally:
+            await cli.close()
+
+    try:
+        return asyncio.run(_go())
+    except Exception:
+        return []
+
+
+def preempt_node_processes(node, grace_s: float,
+                           stop_event: Optional[threading.Event] = None
+                           ) -> None:
+    """SIGTERM the agent (preemption notice), wait ``grace_s``, then
+    SIGKILL the agent and every worker it hosted — the full lifecycle
+    of an announced VM death.  ``node`` is a cluster_utils.NodeHandle
+    (or anything with .proc and .agent_addr)."""
+    worker_pids = _agent_worker_pids(node.agent_addr)
+    try:
+        node.proc.terminate()  # the notice
+    except (ProcessLookupError, PermissionError):
+        pass
+    if stop_event is not None:
+        stop_event.wait(grace_s)
+    else:
+        time.sleep(grace_s)
+    for pid in [node.proc.pid] + worker_pids:
+        try:
+            os.kill(pid, signal.SIGKILL)  # the VM dies
+        except (ProcessLookupError, PermissionError):
+            pass
+    try:
+        node.proc.wait(timeout=5)
+    except Exception:
+        pass
 
 
 class WorkerKiller(_KillerThread):

@@ -11,12 +11,13 @@ from .train_step import (TrainState, make_optimizer,  # noqa: F401
 from .checkpoint import Checkpoint, CheckpointManager  # noqa: F401
 from .config import (CheckpointConfig, FailureConfig, Result,  # noqa
                      RunConfig, ScalingConfig, TelemetryConfig)
-from .session import (checkpoint_dir, data_wait,  # noqa: F401
-                      get_checkpoint, get_dataset_shard, get_local_rank,
-                      get_world_rank, get_world_size, report)
+from .session import (checkpoint_dir, checkpoint_on_notice,  # noqa
+                      data_wait, get_checkpoint, get_dataset_shard,
+                      get_local_rank, get_world_rank, get_world_size,
+                      interrupted, interruption, report)
 from .trainer import (DataParallelTrainer, JaxTrainer,  # noqa: F401
                       TorchTrainer)
-from .worker_group import WorkerGroup  # noqa: F401
+from .worker_group import PreemptionError, WorkerGroup  # noqa: F401
 from .v2 import (ControllerState, ElasticScalingPolicy,  # noqa: F401
                  FailureDecision, FailurePolicy, FixedScalingPolicy,
-                 JaxTrainerV2, TrainControllerV2)
+                 JaxTrainerV2, RestartBackoff, TrainControllerV2)

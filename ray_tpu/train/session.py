@@ -39,6 +39,12 @@ class TrainSession:
     _report_index: int = 0
     _last_report_ts: Optional[float] = None
     _clock: Any = time.monotonic  # injectable for telemetry tests
+    # Drain plane: sticky interruption notice (a preemption/drain was
+    # announced for a node hosting this gang).  Set by the throttled
+    # result-queue poll; once set it never clears for this attempt.
+    _interrupt: Optional[Dict[str, Any]] = None
+    _last_interrupt_poll: float = 0.0
+    _interrupt_poll_period_s: float = 1.0
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
@@ -48,10 +54,54 @@ class TrainSession:
                    "index": self._report_index,
                    "checkpoint_path": checkpoint.path if checkpoint
                    else None}
+        if checkpoint is not None and self.interrupted():
+            # Tag the payload so the driver (and the metrics history)
+            # can tell a checkpoint-on-notice from a periodic save.
+            payload["preempt_ckpt"] = True
         if self.result_queue is not None:
             import ray_tpu
 
             ray_tpu.get(self.result_queue.push.remote(payload))
+
+    # ------------------------------------------------- drain/preemption
+    def interruption(self) -> Optional[Dict[str, Any]]:
+        """The drain notice for this gang, or None.  When a node
+        hosting the gang enters DRAINING (preemption notice or ``rt
+        drain``), the trainer driver flags the run's result queue; the
+        session polls that flag (throttled to one RPC per
+        ``_interrupt_poll_period_s``) so a per-step check costs ~0.
+
+        The returned dict carries ``reason``, ``node_id`` and
+        ``deadline`` (unix time the node is expected to die) — the
+        budget rank 0 has for a checkpoint-on-notice.  Polling
+        continues after the first notice: the queue keeps the
+        earliest-deadline notice, and a tighter one arriving later
+        (a real preemption during a leisurely operator drain) must
+        replace the stale budget."""
+        if self.result_queue is None:
+            return self._interrupt
+        now = self._clock()
+        if now - self._last_interrupt_poll < \
+                self._interrupt_poll_period_s:
+            return self._interrupt
+        self._last_interrupt_poll = now
+        try:
+            import ray_tpu
+
+            latest = ray_tpu.get(
+                self.result_queue.interrupt_info.remote())
+            if latest is not None:
+                self._interrupt = latest
+        except Exception:
+            pass  # queue dying usually means the gang is too
+        return self._interrupt
+
+    def interrupted(self) -> bool:
+        """True once a drain/preemption notice covers this gang — the
+        train loop should checkpoint (rank 0) and keep going; the
+        controller restarts from that checkpoint without burning a
+        ``max_failures`` slot."""
+        return self.interruption() is not None
 
     def _observe_step(self, metrics: Dict[str, Any]) -> None:
         """Per-step telemetry: the report cadence IS the step cadence,
@@ -159,11 +209,38 @@ def get_local_rank() -> int:
     return get_session().local_rank
 
 
+def interrupted() -> bool:
+    """True once a drain/preemption notice covers this gang."""
+    return get_session().interrupted()
+
+
+def interruption() -> Optional[Dict[str, Any]]:
+    """The gang's drain notice ({reason, node_id, deadline}) or None."""
+    return get_session().interruption()
+
+
 @contextmanager
 def checkpoint_dir():
     """Scratch dir for building a checkpoint before report()."""
     d = tempfile.mkdtemp(prefix="rt_ckpt_build_")
     yield d
+
+
+@contextmanager
+def checkpoint_on_notice():
+    """Wrap the urgent save a train loop performs after
+    ``interrupted()`` turns true: attributes the elapsed time to the
+    ``checkpoint_on_notice`` goodput sub-phase (distinct from periodic
+    ``checkpoint`` saves) and observes its duration histogram — the
+    measured cost of converting an announced failure into a bounded
+    one."""
+    from ..util import goodput
+
+    with goodput.timed_phase(
+            "checkpoint_on_notice",
+            "rt_train_ckpt_on_notice_seconds",
+            "Rank-0 checkpoint save raced against a drain deadline."):
+        yield
 
 
 @contextmanager

@@ -31,10 +31,14 @@ from .worker_group import WorkerGroup, WorkerGroupError
 
 @ray_tpu.remote
 class _ResultQueue:
-    """Collects session.report payloads from all ranks."""
+    """Collects session.report payloads from all ranks; doubles as the
+    gang's interruption flag (the drain notice travels driver ->
+    queue -> every rank's session poll — the queue is the one actor
+    every rank already talks to)."""
 
     def __init__(self):
         self.items = []
+        self.interrupt = None
 
     def push(self, payload):
         self.items.append(payload)
@@ -43,6 +47,21 @@ class _ResultQueue:
     def drain(self):
         out, self.items = self.items, []
         return out
+
+    def set_interrupt(self, info):
+        def _dl(n):
+            return n.get("deadline") or float("inf")
+
+        # Earliest DEADLINE wins, not first arrival: a later notice
+        # with a tighter deadline (a real preemption landing during a
+        # leisurely operator drain) must reach rank 0, or it races its
+        # checkpoint against the wrong clock.
+        if self.interrupt is None or _dl(info) < _dl(self.interrupt):
+            self.interrupt = dict(info)
+        return True
+
+    def interrupt_info(self):
+        return self.interrupt
 
 
 class BaseTrainer:
@@ -91,8 +110,14 @@ class BaseTrainer:
             resources_per_worker=sc.worker_resources(),
             placement_strategy=sc.placement_strategy
             if sc.num_workers > 1 else None)
+        # num_cpus=0: the queue is a metadata actor, and it must be
+        # schedulable even when the gang's placement group reserves
+        # every CPU in the cluster — a queue that cannot start
+        # deadlocks the whole attempt (and carries the drain plane's
+        # interruption flag, which must work under exactly that
+        # full-reservation pressure).
         queue = _ResultQueue.options(
-            name=f"train_results_{run_id}").remote()
+            name=f"train_results_{run_id}", num_cpus=0).remote()
         backend = self.backend_cls()
         try:
             backend.on_start(group, run_id)
@@ -115,15 +140,65 @@ class BaseTrainer:
                     self.run_config.telemetry))
             final_metrics: Dict = {}
             pending = list(refs)
+            self._drain_notice = None
+            self._drain_notices = {}
+            self._last_drain_poll = 0.0
             while pending:
                 done, pending = ray_tpu.wait(pending, num_returns=1,
                                              timeout=1.0)
                 self._drain(queue, manager, history)
+                self._poll_drain(group, queue)
                 for ref in done:
                     try:
                         ray_tpu.get(ref)
                     except Exception as e:  # noqa: BLE001
                         rank = refs.index(ref)
+                        # force=True: the loop's poll just ran and the
+                        # throttle would hide a notice that landed in
+                        # the last second — this path runs once per
+                        # attempt, so the extra RPC is free.
+                        self._poll_drain(group, queue, force=True)
+                        notices = getattr(self, "_drain_notices", {})
+                        notice = notices.get(
+                            group.workers[rank].node_id)
+                        if notice is None and notices:
+                            # The failed rank sits on a HEALTHY node,
+                            # but a gang peer's node is draining: the
+                            # first observed failure of a preempted
+                            # gang is often a surviving rank whose
+                            # collective to the dying peer broke.
+                            # Infra errors in that window are the
+                            # cascade of the announced failure;
+                            # deterministic user-code exceptions keep
+                            # normal accounting (they would recur on
+                            # any node).
+                            from .worker_group import \
+                                DETERMINISTIC_ERRORS
+
+                            if not isinstance(e, DETERMINISTIC_ERRORS):
+                                notice = min(
+                                    notices.values(),
+                                    key=lambda n:
+                                    n.get("deadline") or float("inf"))
+                        if notice is not None:
+                            # ANNOUNCED failure: the failed rank's OWN
+                            # node told us it was going before it
+                            # died.  Classify so the controller
+                            # restarts from the checkpoint-on-notice
+                            # without burning a max_failures slot.  A
+                            # rank failing on a HEALTHY node while
+                            # some other node drains is still a crash
+                            # (or a user bug) and keeps normal
+                            # accounting.
+                            from .worker_group import PreemptionError
+
+                            raise WorkerGroupError(rank, PreemptionError(
+                                f"worker {rank} lost to node drain/"
+                                f"preemption "
+                                f"({notice.get('reason', '?')})",
+                                node_id=notice.get("node_id", ""),
+                                reason=notice.get("reason", ""),
+                                cause=e)) from e
                         raise WorkerGroupError(rank, e) from e
             self._drain(queue, manager, history)
             if history:
@@ -140,6 +215,55 @@ class BaseTrainer:
                 ray_tpu.kill(queue)
             except Exception:
                 pass
+
+    def _poll_drain(self, group: WorkerGroup, queue, force: bool = False):
+        """Watch for drain/preemption notices on nodes hosting the
+        gang (throttled to ~1 poll/s unless ``force``).  Notices
+        accumulate in ``self._drain_notices`` keyed by node id (a
+        preemption wave can drain several gang nodes at once); the
+        first hit flags the run's result queue so every rank's session
+        sees ``interrupted()`` and rank 0 can checkpoint-on-notice
+        inside the grace window."""
+        notices = getattr(self, "_drain_notices", None)
+        if notices is None:
+            notices = self._drain_notices = {}
+        now = time.time()
+        if not force and \
+                now - getattr(self, "_last_drain_poll", 0.0) < 1.0:
+            return self._drain_notice
+        self._last_drain_poll = now
+        try:
+            from ..core import runtime as runtime_mod
+
+            rt = runtime_mod.get_runtime_quiet()
+            if rt is None or not hasattr(rt, "controller_call"):
+                return None
+            gang_nodes = {w.node_id for w in group.workers if w.node_id}
+            for n in rt.controller_call("list_nodes", {}):
+                nid = n["node_id"]
+                nid = nid.hex() if hasattr(nid, "hex") else str(nid)
+                if not n.get("draining") or nid not in gang_nodes \
+                        or nid in notices:
+                    continue
+                notice = notices[nid] = {
+                    "node_id": nid,
+                    "reason": n.get("drain_reason", ""),
+                    "deadline": n.get("drain_deadline", 0.0)}
+                if self._drain_notice is None:
+                    self._drain_notice = notice
+                # EVERY new notice reaches the queue — it keeps the
+                # one with the earliest deadline, so a tighter notice
+                # arriving later still reaches the workers.
+                try:
+                    ray_tpu.get(queue.set_interrupt.remote(notice))
+                except Exception:
+                    pass  # queue gone == gang already dying
+                from ..util import flight_recorder
+
+                flight_recorder.record("train_drain_notice", **notice)
+        except Exception:
+            return self._drain_notice  # polling must never fail fit
+        return self._drain_notice
 
     def _drain(self, queue, manager: CheckpointManager,
                history: list) -> None:

@@ -12,8 +12,9 @@ failure domain; per-worker patching is not meaningful under SPMD).
 
 from __future__ import annotations
 
+import random
 import time
-from dataclasses import dataclass, replace
+from dataclasses import dataclass, field, replace
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional
 
@@ -22,7 +23,8 @@ import ray_tpu
 from .checkpoint import CheckpointManager
 from .config import Result
 from .trainer import BaseTrainer, JaxBackend
-from .worker_group import WorkerGroupError
+from .worker_group import (DETERMINISTIC_ERRORS, PreemptionError,
+                           WorkerGroupError)
 
 
 class ControllerState(str, Enum):
@@ -104,19 +106,78 @@ class FailureDecision(str, Enum):
     RAISE = "RAISE"
 
 
+# See worker_group.DETERMINISTIC_ERRORS for the rationale (shared with
+# the trainer's announced-failure classification).
+_DETERMINISTIC_ERRORS = DETERMINISTIC_ERRORS
+
+
 @dataclass
 class FailurePolicy:
-    """ref: v2 FailurePolicy — bounded retries by default."""
+    """ref: v2 FailurePolicy — bounded retries, but with error
+    classification: deterministic user-code exceptions RAISE
+    immediately, and announced preemptions always RETRY (budget
+    accounting for those lives in the controller)."""
 
     max_failures: int = 3
 
     def decide(self, failure_count: int,
                error: BaseException) -> FailureDecision:
+        if isinstance(error, PreemptionError):
+            # Announced failure: retrying is the whole point of the
+            # drain plane, and it costs no budget.
+            return FailureDecision.RETRY
+        if isinstance(error, _DETERMINISTIC_ERRORS):
+            return FailureDecision.RAISE
         if self.max_failures < 0:  # infinite retries
             return FailureDecision.RETRY
         return (FailureDecision.RETRY
                 if failure_count <= self.max_failures
                 else FailureDecision.RAISE)
+
+
+@dataclass
+class RestartBackoff:
+    """Jittered exponential delay between gang restart attempts.
+
+    The pre-drain-plane controller hot-looped: teardown -> reschedule
+    -> fail -> teardown, burning scheduler/API cycles during incidents
+    and synchronizing every driver's retries after a fleet-wide
+    preemption wave.  delay(n) = min(max_s, base_s * multiplier**n),
+    scaled by a uniform factor in [1-jitter, 1+jitter].  ``reset()``
+    after a successful (or long-lived) attempt.  Configured via the
+    ``RT_RESTART_BACKOFF_*`` flags; ``base_s=0`` disables delays.
+    """
+
+    base_s: float = 1.0
+    max_s: float = 60.0
+    multiplier: float = 2.0
+    jitter: float = 0.2
+    rng: Any = field(default_factory=random.Random, repr=False)
+    _consecutive: int = 0
+
+    @classmethod
+    def from_config(cls, config=None) -> "RestartBackoff":
+        if config is None:
+            from ..core.config import RuntimeConfig
+
+            config = RuntimeConfig.from_env()
+        return cls(base_s=config.restart_backoff_base_s,
+                   max_s=config.restart_backoff_max_s,
+                   multiplier=config.restart_backoff_multiplier,
+                   jitter=config.restart_backoff_jitter)
+
+    def next_delay(self) -> float:
+        """Delay before the NEXT attempt; advances the schedule."""
+        if self.base_s <= 0:
+            return 0.0
+        raw = min(self.max_s,
+                  self.base_s * self.multiplier ** self._consecutive)
+        self._consecutive += 1
+        j = max(0.0, min(self.jitter, 1.0))
+        return raw * (1.0 + j * (2.0 * self.rng.random() - 1.0))
+
+    def reset(self) -> None:
+        self._consecutive = 0
 
 
 class TrainControllerV2:
@@ -126,14 +187,19 @@ class TrainControllerV2:
 
     def __init__(self, trainer: BaseTrainer,
                  scaling_policy: Optional[ScalingPolicy] = None,
-                 failure_policy: Optional[FailurePolicy] = None):
+                 failure_policy: Optional[FailurePolicy] = None,
+                 restart_backoff: Optional[RestartBackoff] = None):
         self.trainer = trainer
         self.scaling_policy = scaling_policy or FixedScalingPolicy(
             trainer.scaling_config.num_workers)
         self.failure_policy = failure_policy or FailurePolicy(
             trainer.run_config.failure_config.max_failures)
+        self.restart_backoff = restart_backoff or \
+            RestartBackoff.from_config()
         self.state_history: List[Dict[str, Any]] = []
         self.attempt_sizes: List[int] = []
+        self.backoff_delays: List[float] = []   # observed (tests/ops)
+        self.announced_failures = 0             # preemptions absorbed
         self._restarting = False
 
     def _transition(self, state: ControllerState, **info) -> None:
@@ -200,6 +266,7 @@ class TrainControllerV2:
             self.attempt_sizes.append(size)
             self._transition(ControllerState.RUNNING, workers=size)
             self._mark_restart(False)
+            t_attempt = time.time()
             try:
                 final = self.trainer._run_attempt(manager, start_ckpt,
                                                   history)
@@ -208,19 +275,48 @@ class TrainControllerV2:
                               checkpoint=manager.latest(),
                               path=run_dir, metrics_history=history)
             except WorkerGroupError as e:
-                failures += 1
+                if time.time() - t_attempt > self.restart_backoff.max_s:
+                    # A long-lived attempt means the cluster was
+                    # healthy again; don't punish a fresh incident
+                    # with the tail of the previous one's schedule.
+                    self.restart_backoff.reset()
+                announced = isinstance(e.cause, PreemptionError)
+                if announced:
+                    # An ANNOUNCED failure (drain/preemption notice
+                    # preceded the death): the gang already raced a
+                    # checkpoint-on-notice, so the restart resumes
+                    # from it — and it costs no max_failures slot,
+                    # because preemption frequency is a property of
+                    # the (spot) fleet, not of the user's job.
+                    self.announced_failures += 1
+                else:
+                    failures += 1
                 decision = self.failure_policy.decide(failures, e.cause)
                 if decision == FailureDecision.RAISE:
                     self._transition(ControllerState.ERRORED,
-                                     error=repr(e.cause))
+                                     error=repr(e.cause),
+                                     failures=failures)
                     return Result(
                         metrics=history[-1]["metrics"] if history
                         else {},
                         checkpoint=manager.latest(), path=run_dir,
                         error=e.cause, metrics_history=history)
                 self._transition(ControllerState.RESTARTING,
-                                 failures=failures)
+                                 failures=failures,
+                                 announced=announced)
                 self._mark_restart(True)
+                # Jittered exponential backoff between attempts: the
+                # old hot-loop retry re-failed instantly during
+                # incidents and synchronized restarts fleet-wide
+                # after a preemption wave.  The wait is restart
+                # downtime, so it accrues to the ``restart`` goodput
+                # phase entered just above.
+                delay = self.restart_backoff.next_delay()
+                if delay > 0:
+                    self.backoff_delays.append(delay)
+                    self._transition(ControllerState.RESTARTING,
+                                     backoff_s=round(delay, 3))
+                    time.sleep(delay)
                 start_ckpt = manager.latest()
                 attempt += 1
 

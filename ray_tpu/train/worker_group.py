@@ -26,6 +26,34 @@ class WorkerGroupError(RuntimeError):
         super().__init__(f"training worker {rank} failed: {cause!r}")
 
 
+# Exception types that recur on every attempt when raised by USER code
+# inside the train loop: retrying burns the whole max_failures budget
+# (and the TPU-hours behind it) on an error a stack trace already
+# explains.  Infra errors never subclass these directly — a remote
+# user exception re-raises as a TaskError dual-subclass
+# (errors.make_task_error), so isinstance() still identifies them.
+DETERMINISTIC_ERRORS = (
+    ValueError, TypeError, KeyError, IndexError, AttributeError,
+    ZeroDivisionError, AssertionError, NotImplementedError,
+)
+
+
+class PreemptionError(RuntimeError):
+    """A training worker was lost to an ANNOUNCED failure: its node
+    delivered a preemption/drain notice before dying.  The v2
+    controller treats this differently from a crash — the restart does
+    not consume a ``FailureConfig.max_failures`` budget slot, because
+    preemption frequency is a property of the fleet, not of the job
+    (cf. Bamboo NSDI'23 / Gemini SOSP'23 on spot-instance training)."""
+
+    def __init__(self, message: str, node_id: str = "",
+                 reason: str = "", cause: BaseException = None):
+        super().__init__(message)
+        self.node_id = node_id
+        self.reason = reason
+        self.cause = cause
+
+
 @ray_tpu.remote
 class _TrainWorkerActor:
     """Hosts the user's train loop; one per rank."""

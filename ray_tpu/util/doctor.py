@@ -8,6 +8,10 @@ rendered with an explanation and the suggested next probe —
 
   dead-owner leases     workers pinned by an owner whose connection is
                         gone (``rt list leases``)
+  draining nodes        nodes in the DRAINING lifecycle (preemption
+                        notice / ``rt drain``) — named with reason and
+                        remaining grace; a node DRAINING past its
+                        deadline is the critical stale-drain finding
   never-idle nodes      a node that reports busy while the cluster has
                         no work — stranded leases/bundles
   infeasible PGs        pending placement groups no alive node can
@@ -320,6 +324,53 @@ def find_never_idle_nodes(load: Dict, ledgers: List[Dict],
     return out
 
 
+def find_draining_nodes(nodes: List[Dict], now: float) -> List[Dict]:
+    """Surface every node in the DRAINING lifecycle state: an active
+    drain is a warning naming the node, reason, and remaining grace
+    (operators watching a preemption wave see exactly which hosts are
+    going); a node still DRAINING past its deadline is the CRITICAL
+    stale-drain finding — the node should be dead or done by then, so
+    something is wedged (`rt doctor` exits non-zero on it)."""
+    out = []
+    for n in nodes or []:
+        if not n.get("alive") or not n.get("draining"):
+            continue
+        nid = str(n.get("node_id", "?"))[:12]
+        reason = n.get("drain_reason") or "?"
+        deadline = float(n.get("drain_deadline") or 0.0)
+        overdue = deadline and now > deadline
+        if overdue:
+            out.append(_finding(
+                "stale_drain", "critical",
+                f"node {nid} has been DRAINING past its deadline by "
+                f"{now - deadline:.0f}s ({reason})",
+                detail="the drain grace expired but the node neither "
+                       "died nor finished draining — its leases are "
+                       "stranded and the replacement the autoscaler "
+                       "started is now double capacity.",
+                probe=f"rt list leases; rt logs --node {nid}",
+                data={"node": nid, "reason": reason,
+                      "deadline": deadline,
+                      "overdue_s": now - deadline}))
+        else:
+            remaining = deadline - now if deadline else 0.0
+            out.append(_finding(
+                "draining_node", "warning",
+                f"node {nid} is DRAINING ({reason})"
+                + (f", {remaining:.0f}s of grace left"
+                   if deadline else ""),
+                detail="the node stopped accepting leases and will "
+                       "die at the deadline; gangs on it should be "
+                       "checkpointing-on-notice and the autoscaler "
+                       "should be starting a replacement.",
+                probe="rt list leases; rt telemetry (checkpoint_on_"
+                      "notice phase)",
+                data={"node": nid, "reason": reason,
+                      "deadline": deadline,
+                      "remaining_s": remaining}))
+    return out
+
+
 def find_infeasible_pgs(pgs: List[Dict], nodes: List[Dict]
                         ) -> List[Dict]:
     """Pending placement groups with a bundle no alive node's TOTAL
@@ -418,6 +469,7 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
     findings += find_hung_collectives(
         feed.get("collective_inflight") or [], now,
         collective_watchdog_s)
+    findings += find_draining_nodes(nodes, now)
     findings += find_lease_problems(ledgers, now)
     findings += find_infeasible_pgs(pgs, nodes)
     findings += find_stuck_tasks(tasks, now, min_s=stuck_task_min_s,

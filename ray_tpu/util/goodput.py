@@ -10,6 +10,10 @@ to one of a fixed phase taxonomy
     compute     — running training steps on the accelerator
     compile     — XLA tracing/compilation (first step, reshards)
     checkpoint  — saving/restoring model state
+    checkpoint_on_notice — an urgent save raced against a drain
+                  deadline (preemption notice); kept separate from
+                  ``checkpoint`` so the cost of announced failures is
+                  measurable on its own
     restart     — gang teardown + reschedule after a failure
     data_stall  — the step loop waiting on input data
     idle        — everything unattributed (setup, queue waits, ...)
@@ -35,8 +39,8 @@ import time
 from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional
 
-PHASES = ("compute", "compile", "checkpoint", "restart", "data_stall",
-          "idle")
+PHASES = ("compute", "compile", "checkpoint", "checkpoint_on_notice",
+          "restart", "data_stall", "idle")
 
 GAUGE_NAME = "rt_goodput_seconds"
 

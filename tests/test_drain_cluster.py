@@ -1,0 +1,277 @@
+"""Acceptance (ISSUE 4): on a TWO-NODE cluster, SIGTERM-preempting a
+node that hosts a training worker mid-run produces
+
+  - a drain notice the gang observes (``train.interrupted()``) and a
+    rank-0 checkpoint-on-notice raced against the drain deadline,
+  - a gang restart that resumes from THAT checkpoint (not the last
+    periodic one), sized down to the surviving capacity,
+  - no ``FailureConfig.max_failures`` consumption (the budget is 0 and
+    the run still finishes),
+  - inter-attempt delays following the configured jittered backoff,
+  - ``rt doctor`` naming the draining node while the grace runs.
+
+Plus the operator path end to end: ``rt drain <node>`` drains via the
+CLI, ``rt doctor`` reports the draining node, and once the deadline
+passes the stale-drain finding flips the doctor exit code non-zero.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ENV = {
+    "RT_METRICS_REPORT_PERIOD_S": "0.5",
+    "RT_RAYLET_HEARTBEAT_PERIOD_MS": "300",   # fast death detection
+    "RT_PREEMPTION_GRACE_S": "4",             # SIGTERM drain window
+    "RT_RESTART_BACKOFF_BASE_S": "0.3",
+    "RT_RESTART_BACKOFF_MAX_S": "1.0",
+    "RT_RESTART_BACKOFF_JITTER": "0.25",
+}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    old = {k: os.environ.get(k) for k in _ENV}
+    os.environ.update(_ENV)
+    c = Cluster(head_node_args={"num_cpus": 3})
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.address)
+    c.wait_for_nodes()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _rt(*args, timeout=90):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", *args],
+        capture_output=True, text=True, env=env, timeout=timeout)
+
+
+def _wait(pred, timeout=60, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.2)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def _loop(config):
+    """Training loop: one periodic checkpoint at step 1, then none —
+    so a resume past step 1 can ONLY come from the checkpoint-on-
+    notice the drain triggers."""
+    import time as _time
+
+    from ray_tpu import train
+    from ray_tpu.train import Checkpoint
+
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        start = ckpt.load_json("meta")["step"]
+    saved_notice = False
+    for step in range(start, config["steps"]):
+        _time.sleep(0.2)
+        if train.get_world_rank() != 0:
+            train.report({"step": step, "start": start})
+            continue
+        if train.interrupted() and not saved_notice:
+            saved_notice = True
+            with train.checkpoint_on_notice():
+                with train.checkpoint_dir() as d:
+                    c = Checkpoint(d)
+                    c.save_json("meta", {"step": step})
+                    train.report({"step": step, "start": start,
+                                  "notice": True}, checkpoint=c)
+        elif step == 1:
+            with train.checkpoint_dir() as d:
+                c = Checkpoint(d)
+                c.save_json("meta", {"step": step})
+                train.report({"step": step, "start": start},
+                             checkpoint=c)
+        else:
+            train.report({"step": step, "start": start})
+        with open(config["progress"], "w") as f:
+            f.write(str(step))
+    return start
+
+
+@pytest.mark.slow
+def test_preempting_training_node_checkpoints_and_restarts(
+        cluster, tmp_path):
+    from ray_tpu.train import (ElasticScalingPolicy, FailurePolicy,
+                               RunConfig, ScalingConfig,
+                               TrainControllerV2)
+    from ray_tpu.train.backend import Backend
+    from ray_tpu.train.trainer import BaseTrainer
+
+    progress = str(tmp_path / "progress")
+    trainer = BaseTrainer(
+        _loop,
+        train_loop_config={"steps": 60, "progress": progress},
+        scaling_config=ScalingConfig(
+            num_workers=2, resources_per_worker={"CPU": 2.0},
+            placement_strategy="STRICT_SPREAD"),
+        run_config=RunConfig(name="preempt",
+                             storage_path=str(tmp_path)))
+    trainer.backend_cls = Backend  # the loop doesn't use jax
+    controller = TrainControllerV2(
+        trainer,
+        scaling_policy=ElasticScalingPolicy(
+            min_workers=1, max_workers=2,
+            resources_per_worker={"CPU": 2.0}),
+        failure_policy=FailurePolicy(max_failures=0))
+
+    doomed = cluster.nodes[1]
+    side = {}
+
+    def assassin():
+        try:
+            # Wait until training is genuinely underway.
+            _wait(lambda: os.path.exists(progress) and
+                  int(open(progress).read() or 0) >= 3,
+                  timeout=60, what="training progress")
+            from ray_tpu.testing.chaos import _agent_worker_pids
+
+            worker_pids = _agent_worker_pids(doomed.agent_addr)
+            doomed.proc.terminate()  # the preemption notice
+            # Mid-grace: the controller must already show the node
+            # DRAINING and rt doctor must name it.
+            _wait(lambda: any(
+                n["Draining"] and n["NodeID"] == doomed.node_id_hex
+                for n in ray_tpu.nodes()), timeout=3,
+                what="controller sees DRAINING")
+            d = _rt("doctor", "--format", "json",
+                    "--address", cluster.address, timeout=30)
+            side["doctor"] = json.loads(d.stdout or "{}")
+            # Let the rest of the grace window elapse, then the "VM"
+            # dies: agent and workers alike.
+            time.sleep(3.0)
+            for pid in [doomed.proc.pid] + worker_pids:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        except Exception as e:  # surfaced by the main thread
+            side["error"] = repr(e)
+
+    t = threading.Thread(target=assassin, daemon=True)
+    t.start()
+    result = controller.fit()
+    t.join(timeout=30)
+    assert "error" not in side, side["error"]
+
+    # The run FINISHED despite max_failures=0: the preemption was
+    # announced, so it consumed no budget.
+    assert result.error is None, result.error
+    assert controller.announced_failures == 1
+    restarts = [s for s in controller.state_history
+                if s["state"] == "RESTARTING"]
+    assert any(s.get("announced") for s in restarts), \
+        controller.state_history
+
+    # The next attempt resized to the surviving capacity (2 -> 1).
+    assert controller.attempt_sizes[0] == 2, controller.attempt_sizes
+    assert controller.attempt_sizes[-1] == 1, controller.attempt_sizes
+
+    # Rank 0 performed a checkpoint-on-notice...
+    notices = [h for h in result.metrics_history
+               if h["metrics"].get("notice")]
+    assert notices, "no checkpoint-on-notice was reported"
+    assert notices[0].get("preempt_ckpt"), notices[0]
+    notice_step = notices[0]["metrics"]["step"]
+    assert notice_step >= 2
+    # ...and the restart resumed from IT, not from the step-1
+    # periodic checkpoint.
+    starts = {h["metrics"]["start"] for h in result.metrics_history}
+    assert starts == {0, notice_step}, (starts, notice_step)
+    final_steps = [h["metrics"]["step"] for h in result.metrics_history]
+    assert max(final_steps) == 59
+
+    # Inter-attempt delay followed the configured jittered backoff
+    # (base 0.3, jitter 0.25 -> [0.225, 0.375]).
+    assert len(controller.backoff_delays) == 1, \
+        controller.backoff_delays
+    assert 0.225 <= controller.backoff_delays[0] <= 0.375
+
+    # rt doctor named the draining node while the grace ran.
+    diag = side.get("doctor") or {}
+    drains = [f for f in diag.get("findings", [])
+              if f["check"] in ("draining_node", "stale_drain")]
+    assert drains, diag
+    assert any(doomed.node_id_hex[:12] in f["summary"]
+               for f in drains), drains
+
+
+def test_rt_drain_cli_and_stale_drain_exit_code(cluster):
+    """Operator path: `rt drain <node>` + `rt doctor` end to end on a
+    throwaway node; once the deadline passes, the stale-drain finding
+    makes `rt doctor` exit non-zero."""
+    extra = cluster.add_node(num_cpus=0, resources={"drainme": 1})
+    # Not wait_for_nodes(): the preemption test legitimately left a
+    # dead node in the fixture's list.
+    _wait(lambda: any(n["NodeID"] == extra.node_id_hex and n["Alive"]
+                      for n in ray_tpu.nodes()),
+          timeout=30, what="extra node registration")
+    try:
+        out = _rt("drain", extra.node_id_hex[:12], "--grace", "2",
+                  "--reason", "maintenance",
+                  "--address", cluster.address)
+        assert out.returncode == 0, out.stderr + out.stdout
+        assert "DRAINING" in out.stdout
+
+        node = next(n for n in ray_tpu.nodes()
+                    if n["NodeID"] == extra.node_id_hex)
+        assert node["Draining"] and node["DrainReason"] == "maintenance"
+
+        # `rt status` marks it, and doctor names it while in grace.
+        st = _rt("status", "--address", cluster.address)
+        assert "DRAIN" in st.stdout
+        d = _rt("doctor", "--format", "json",
+                "--address", cluster.address)
+        diag = json.loads(d.stdout)
+        active = [f for f in diag["findings"]
+                  if f["check"] == "draining_node"]
+        assert any(extra.node_id_hex[:12] in f["summary"]
+                   for f in active), diag["findings"]
+
+        # The agent refuses new leases for this node's resources now.
+        lease = _rt("list", "nodes", "--format", "json",
+                    "--address", cluster.address)
+        assert lease.returncode == 0
+
+        # Past the deadline: stale drain -> critical -> exit 1.
+        def _stale():
+            r = _rt("doctor", "--format", "json",
+                    "--address", cluster.address)
+            diag = json.loads(r.stdout)
+            stale = [f for f in diag["findings"]
+                     if f["check"] == "stale_drain"
+                     and extra.node_id_hex[:12] in f["summary"]]
+            return (r, stale) if stale else None
+
+        r, stale = _wait(_stale, timeout=15, what="stale drain")
+        assert r.returncode == 1, (r.returncode, r.stdout)
+        assert stale[0]["severity"] == "critical"
+    finally:
+        cluster.remove_node(extra)

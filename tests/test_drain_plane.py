@@ -1,0 +1,439 @@
+"""Drain-plane units (no cluster): restart backoff schedule, failure
+classification, the controller-v2 announced-failure accounting, the
+doctor's draining/stale-drain checks, the controller's drain bookkeeping
+(replacement demand, resource-view exclusion, prefix resolve), and the
+PreemptionKiller's SIGTERM-grace-SIGKILL sequence.
+"""
+
+import asyncio
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ray_tpu.train import (FailureDecision, FailurePolicy,
+                           PreemptionError, RestartBackoff)
+from ray_tpu.train.worker_group import WorkerGroupError
+from ray_tpu.util import doctor
+
+
+# ------------------------------------------------------------- backoff
+def test_backoff_schedule_exponential_and_capped():
+    b = RestartBackoff(base_s=0.5, max_s=4.0, multiplier=2.0,
+                       jitter=0.0)
+    assert [b.next_delay() for _ in range(6)] == \
+        [0.5, 1.0, 2.0, 4.0, 4.0, 4.0]
+    b.reset()
+    assert b.next_delay() == 0.5
+
+
+def test_backoff_jitter_bounds():
+    b = RestartBackoff(base_s=1.0, max_s=100.0, multiplier=1.0,
+                       jitter=0.25, rng=random.Random(7))
+    delays = [b.next_delay() for _ in range(200)]
+    assert all(0.75 <= d <= 1.25 for d in delays)
+    # Jitter actually varies (not a constant factor).
+    assert max(delays) - min(delays) > 0.1
+
+
+def test_backoff_disabled_with_zero_base():
+    b = RestartBackoff(base_s=0.0)
+    assert b.next_delay() == 0.0
+
+
+def test_backoff_from_env_flags(monkeypatch):
+    monkeypatch.setenv("RT_RESTART_BACKOFF_BASE_S", "0.125")
+    monkeypatch.setenv("RT_RESTART_BACKOFF_MAX_S", "9")
+    monkeypatch.setenv("RT_RESTART_BACKOFF_MULTIPLIER", "3")
+    monkeypatch.setenv("RT_RESTART_BACKOFF_JITTER", "0")
+    b = RestartBackoff.from_config()
+    assert (b.base_s, b.max_s, b.multiplier, b.jitter) == \
+        (0.125, 9.0, 3.0, 0.0)
+    assert [b.next_delay() for _ in range(3)] == [0.125, 0.375, 1.125]
+
+
+# -------------------------------------------- failure classification
+def test_deterministic_user_errors_raise_immediately():
+    p = FailurePolicy(max_failures=5)
+    for exc in (ValueError("bad lr"), TypeError("x"), KeyError("k"),
+                IndexError("i"), AssertionError("a"),
+                ZeroDivisionError("z"), NotImplementedError("n")):
+        assert p.decide(1, exc) == FailureDecision.RAISE, exc
+
+
+def test_deterministic_classification_sees_remote_dual_types():
+    # A user exception crossing the process boundary re-raises as a
+    # TaskError dual subclass; classification must still catch it.
+    from ray_tpu.core.errors import TaskError
+
+    remote = TaskError.from_exception(ValueError("raised in the loop"))
+    assert isinstance(remote, ValueError)
+    assert FailurePolicy(max_failures=5).decide(1, remote) == \
+        FailureDecision.RAISE
+
+
+def test_infra_errors_still_retry_within_budget():
+    from ray_tpu.core.errors import ActorDiedError
+
+    p = FailurePolicy(max_failures=2)
+    crash = ActorDiedError("ab12", "worker exited")
+    assert p.decide(1, crash) == FailureDecision.RETRY
+    assert p.decide(2, crash) == FailureDecision.RETRY
+    assert p.decide(3, crash) == FailureDecision.RAISE
+    assert FailurePolicy(max_failures=-1).decide(99, crash) == \
+        FailureDecision.RETRY
+
+
+def test_preemption_always_retries():
+    p = FailurePolicy(max_failures=0)
+    assert p.decide(100, PreemptionError("announced")) == \
+        FailureDecision.RETRY
+
+
+# --------------------------------- controller v2: announced failures
+class _FakeTrainer:
+    """Duck-typed BaseTrainer: scripted attempt outcomes."""
+
+    def __init__(self, tmp_path, outcomes):
+        from ray_tpu.train import FailureConfig, RunConfig, \
+            ScalingConfig
+
+        self.scaling_config = ScalingConfig(num_workers=1)
+        self.run_config = RunConfig(
+            name="fake", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=0))
+        self.resume_from_checkpoint = None
+        self._outcomes = list(outcomes)
+        self.attempts = 0
+
+    def _run_attempt(self, manager, start_ckpt, history):
+        self.attempts += 1
+        outcome = self._outcomes.pop(0)
+        if outcome is None:
+            history.append({"metrics": {"done": True}})
+            return {"done": True}
+        raise WorkerGroupError(0, outcome)
+
+
+def test_controller_announced_failures_cost_no_budget(tmp_path):
+    """Two preemptions with max_failures=0 still finish, through the
+    configured backoff, with the announced restarts counted apart."""
+    from ray_tpu.train import TrainControllerV2
+
+    ctl = TrainControllerV2(
+        _FakeTrainer(tmp_path, [PreemptionError("p1"),
+                                PreemptionError("p2"), None]),
+        restart_backoff=RestartBackoff(base_s=0.05, max_s=0.2,
+                                       multiplier=2.0, jitter=0.0))
+    t0 = time.monotonic()
+    result = ctl.fit()
+    elapsed = time.monotonic() - t0
+    assert result.error is None
+    assert ctl.trainer.attempts == 3
+    assert ctl.announced_failures == 2
+    assert ctl.backoff_delays == [0.05, 0.1]
+    assert elapsed >= 0.15  # the delays were actually slept
+    states = [s["state"] for s in ctl.state_history]
+    assert states.count("RESTARTING") >= 2
+    announced = [s for s in ctl.state_history
+                 if s["state"] == "RESTARTING" and s.get("announced")]
+    assert announced, ctl.state_history
+
+
+def test_controller_crash_still_burns_budget(tmp_path):
+    from ray_tpu.train import TrainControllerV2
+
+    ctl = TrainControllerV2(
+        _FakeTrainer(tmp_path, [RuntimeError("surprise"), None]),
+        restart_backoff=RestartBackoff(base_s=0.0))
+    result = ctl.fit()  # max_failures=0: one crash exhausts the budget
+    assert isinstance(result.error, RuntimeError)
+    assert ctl.trainer.attempts == 1
+    assert ctl.announced_failures == 0
+
+
+def test_controller_deterministic_error_raises_without_retry(tmp_path):
+    from ray_tpu.train import TrainControllerV2
+
+    trainer = _FakeTrainer(tmp_path, [ValueError("bad config"), None])
+    trainer.run_config.failure_config.max_failures = 5
+    ctl = TrainControllerV2(trainer,
+                            restart_backoff=RestartBackoff(base_s=0.0))
+    result = ctl.fit()
+    assert isinstance(result.error, ValueError)
+    assert trainer.attempts == 1  # no retries burned on it
+
+
+# ------------------------------------------------------ doctor checks
+def _node(nid="aa" * 16, draining=True, deadline=0.0, reason="notice",
+          alive=True):
+    return {"node_id": nid, "alive": alive, "draining": draining,
+            "drain_deadline": deadline, "drain_reason": reason}
+
+
+def test_doctor_names_draining_node():
+    now = 1000.0
+    findings = doctor.find_draining_nodes(
+        [_node(deadline=now + 20)], now)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f["check"] == "draining_node" and f["severity"] == "warning"
+    assert "aa" * 6 in f["summary"]
+    assert "notice" in f["summary"]
+    assert 19 < f["data"]["remaining_s"] <= 20
+
+
+def test_doctor_stale_drain_is_critical():
+    now = 1000.0
+    findings = doctor.find_draining_nodes(
+        [_node(deadline=now - 5)], now)
+    assert findings[0]["check"] == "stale_drain"
+    assert findings[0]["severity"] == "critical"
+    assert findings[0]["data"]["overdue_s"] == pytest.approx(5.0)
+
+
+def test_doctor_ignores_dead_and_undrained_nodes():
+    now = 1000.0
+    assert doctor.find_draining_nodes(
+        [_node(draining=False), _node(alive=False)], now) == []
+
+
+def test_diagnose_includes_drain_findings():
+    now = 1000.0
+    diag = doctor.diagnose(
+        feed={}, tasks=[], spans=[], load={}, pgs=[],
+        nodes=[_node(deadline=now - 1)], ledgers=[], now=now)
+    checks = [f["check"] for f in diag["findings"]]
+    assert "stale_drain" in checks
+    assert not diag["healthy"]
+
+
+# ------------------------------------- controller drain bookkeeping
+def _make_controller():
+    from ray_tpu.core.config import RuntimeConfig
+    from ray_tpu.core.controller import Controller, NodeEntry
+    from ray_tpu.core.ids import NodeID
+
+    ctl = Controller(RuntimeConfig.from_env(), "drain_unit")
+
+    class _AckingAgent:
+        async def call(self, method, payload):
+            return {"ok": True, "draining": True,
+                    "deadline": time.time()
+                    + (payload.get("grace_s") or 30.0)}
+
+    async def _agent(_nid):
+        return _AckingAgent()
+
+    ctl._agent = _agent
+    nid = NodeID.from_random()
+    ctl.nodes[nid] = NodeEntry(
+        node_id=nid, agent_addr="127.0.0.1:1",
+        resources_total={"CPU": 4.0, "TPU": 8.0},
+        resources_available={"CPU": 4.0, "TPU": 8.0},
+        last_heartbeat=time.time())
+    return ctl, nid
+
+
+def test_controller_drain_marks_node_and_advertises_replacement():
+    ctl, nid = _make_controller()
+    r = asyncio.run(ctl.drain_node({
+        "node_id": nid.hex()[:10], "reason": "spot notice",
+        "grace_s": 30.0}))
+    assert r["ok"] and r["draining"]
+    node = ctl.nodes[nid]
+    assert node.draining and node.drain_reason == "spot notice"
+    assert node.drain_deadline > time.time()
+    lm = asyncio.run(ctl.get_load_metrics({}))
+    # The draining node's full shape is advertised as demand so the
+    # autoscaler starts its replacement during the grace window...
+    assert {"CPU": 4.0, "TPU": 8.0} in lm["pending_demands"]
+    assert lm["nodes"][nid.hex()]["draining"] is True
+    # ...and spillback no longer routes new leases onto it.
+    assert nid not in asyncio.run(ctl.resource_view({}))
+    rows = asyncio.run(ctl.list_nodes({}))
+    assert rows[0]["draining"] is True
+
+
+def test_controller_if_idle_drain_does_not_replace():
+    ctl, nid = _make_controller()
+    asyncio.run(ctl.drain_node({"node_id": nid, "if_idle": True}))
+    lm = asyncio.run(ctl.get_load_metrics({}))
+    assert lm["pending_demands"] == []  # idle reap: no replacement
+
+
+def test_controller_drain_refused_when_agent_unreachable():
+    """No agent ACK -> no drain: marking the row anyway would
+    split-brain (agent keeps granting while the controller excludes
+    it, with no reconciliation path)."""
+    ctl, nid = _make_controller()
+
+    async def _no_agent(_nid):
+        return None
+
+    ctl._agent = _no_agent
+    r = asyncio.run(ctl.drain_node({"node_id": nid}))
+    assert not r["ok"]
+    assert not ctl.nodes[nid].draining
+    assert asyncio.run(ctl.get_load_metrics({}))["pending_demands"] == []
+
+
+def test_controller_drain_unknown_and_ambiguous_prefix():
+    ctl, nid = _make_controller()
+    assert not asyncio.run(ctl.drain_node({"node_id": "zz"}))["ok"]
+    from ray_tpu.core.controller import NodeEntry
+    from ray_tpu.core.ids import NodeID
+
+    other = NodeID.from_random()
+    ctl.nodes[other] = NodeEntry(
+        node_id=other, agent_addr="127.0.0.1:2",
+        resources_total={}, resources_available={},
+        last_heartbeat=time.time())
+    assert not asyncio.run(ctl.drain_node({"node_id": ""}))["ok"]
+
+
+def test_heartbeat_mirrors_agent_drain_state():
+    ctl, nid = _make_controller()
+    # The deadline crosses hosts as REMAINING seconds and re-anchors
+    # to the controller clock — agent wall time may be skewed.
+    asyncio.run(ctl.heartbeat({
+        "node_id": nid, "available": {}, "draining": True,
+        "drain_remaining_s": 25.0, "drain_reason": "SIGTERM",
+        "drain_replace": True}))
+    node = ctl.nodes[nid]
+    assert node.draining
+    assert 20.0 < node.drain_deadline - time.time() <= 25.5
+    # A later heartbeat without drain fields must NOT clear the state.
+    asyncio.run(ctl.heartbeat({"node_id": nid, "available": {}}))
+    assert ctl.nodes[nid].draining
+
+
+def test_result_queue_interrupt_earliest_deadline_wins():
+    from ray_tpu.train.trainer import _ResultQueue
+
+    q = _ResultQueue._cls()  # the plain class behind @ray_tpu.remote
+    q.set_interrupt({"node_id": "a", "deadline": 1000.0})
+    q.set_interrupt({"node_id": "b", "deadline": 2000.0})
+    assert q.interrupt_info()["node_id"] == "a"  # later+looser ignored
+    q.set_interrupt({"node_id": "c", "deadline": 500.0})
+    assert q.interrupt_info()["node_id"] == "c"  # later+tighter wins
+
+
+# ----------------------------------------------- preemption killer
+def test_preemption_sequence_sigterm_grace_sigkill(tmp_path):
+    """A victim that ignores SIGTERM still dies at the deadline — and
+    observably received the notice first."""
+    marker = tmp_path / "got_term"
+    child = subprocess.Popen([sys.executable, "-c", (
+        "import signal, time, sys\n"
+        f"signal.signal(signal.SIGTERM, lambda *a: open({str(marker)!r},"
+        " 'w').close())\n"
+        "time.sleep(60)\n")])
+    try:
+        time.sleep(0.5)  # let the handler install
+
+        class _Node:
+            proc = child
+            agent_addr = "127.0.0.1:1"  # no agent: worker scan is empty
+
+        from ray_tpu.testing import preempt_node_processes
+
+        t0 = time.monotonic()
+        preempt_node_processes(_Node(), grace_s=0.8)
+        assert time.monotonic() - t0 >= 0.8
+        assert child.poll() is not None  # SIGKILLed at the deadline
+        assert child.returncode == -signal.SIGKILL
+        assert marker.exists()  # ...but the notice arrived first
+    finally:
+        if child.poll() is None:
+            child.kill()
+
+
+def test_preemption_killer_thread_respects_max_kills(tmp_path):
+    from ray_tpu.testing import PreemptionKiller
+
+    procs = [subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(60)"])
+             for _ in range(3)]
+
+    class _N:
+        def __init__(self, p):
+            self.proc = p
+            self.agent_addr = "127.0.0.1:1"
+
+    class _C:
+        nodes = [_N(p) for p in procs]
+
+    killer = PreemptionKiller(_C(), interval_s=0.1, grace_s=0.1,
+                              seed=3, spare_head=True,
+                              max_kills=1).start()
+    try:
+        deadline = time.time() + 10
+        while not killer.kills and time.time() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.5)
+        assert len(killer.kills) == 1
+        assert procs[0].poll() is None  # head spared
+    finally:
+        killer.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+# ------------------------------------------------- goodput sub-phase
+def test_checkpoint_on_notice_goodput_phase():
+    from ray_tpu.train.session import checkpoint_on_notice
+    from ray_tpu.util import goodput
+
+    goodput.reset()
+    with checkpoint_on_notice():
+        time.sleep(0.05)
+    snap = goodput.ledger().snapshot()
+    assert snap["seconds"]["checkpoint_on_notice"] >= 0.04
+    assert snap["seconds"]["checkpoint"] == 0.0  # distinct sub-phase
+
+
+# --------------------------------------- gcp provider preemption reap
+def test_gcp_reap_preempted_relaunch_accounting(tmp_path):
+    """reap_preempted untracks PREEMPTED/TERMINATED (and vanished)
+    nodes and deletes the dead cloud resource, so the autoscaler's
+    counts drop below target and a replacement launches."""
+    from ray_tpu.autoscaler.gcp_provider import GCPTpuNodeProvider
+
+    provider = object.__new__(GCPTpuNodeProvider)  # skip bootstrap
+    import itertools
+    import threading
+
+    provider._lock = threading.Lock()
+    provider._nodes = {}
+    provider._counter = itertools.count(1)
+    killed, deleted = [], []
+
+    class _Node:
+        def __init__(self, name):
+            self.provider_node_id = name
+
+    class _Api:
+        def list_nodes(self):
+            return [{"nodeId": "keep", "state": "READY"},
+                    {"nodeId": "gone", "state": "PREEMPTED"},
+                    {"name": "projects/p/locations/z/nodes/term",
+                     "state": "TERMINATED"}]
+
+    provider.api = _Api()
+    provider._kill_node_pids = killed.append
+    provider._delete_cloud_node = deleted.append
+    for name in ("keep", "gone", "term", "vanished"):
+        provider._nodes[name] = _Node(name)
+    reaped = provider.reap_preempted()
+    assert sorted(reaped) == ["gone", "term"]
+    # A node merely MISSING from the listing is unknown, not dead: a
+    # truncated 200 must not reap healthy capacity.
+    assert sorted(provider._nodes) == ["keep", "vanished"]
+    assert sorted(deleted) == ["gone", "term"]
+    assert len(killed) == 2
