@@ -215,6 +215,14 @@ class ClusterRuntime(BaseRuntime):
         self._submitted_holds: Dict[ObjectID, int] = {}  # in-flight args
         self._owned_ids: Set[ObjectID] = set()      # ids created here
         self._owned_plane: Set[ObjectID] = set()    # owned + in the plane
+        self._escaped_refs: Set[ObjectID] = set()   # may have borrowers
+        self._local_puts: Set[ObjectID] = set()     # put()s w/o embedded
+        self._bg_ops: List = []                     # coalesced loop work
+        self._bg_scheduled = False
+        # RLock: _bg_submit is reachable from ObjectRef.__del__, and a
+        # GC run triggered by an allocation under the lock (the drain
+        # loop's list() copy) can re-enter on the same thread.
+        self._bg_lock = threading.RLock()
         # Owned in-band refs that were pickled OUT of this process while
         # still pending: their values must be written through to the
         # object plane on completion (see promote_refs_to_plane).
@@ -321,6 +329,16 @@ class ClusterRuntime(BaseRuntime):
         await self._ctl.connect()
         self._agent = RpcClient(self.agent_addr, tag=f"rt-{os.getpid()}")
         await self._agent.connect()
+        # Direct-write channel for per-object control notifies (see
+        # NotifySideChannel): connected lazily on first notify, but
+        # never DIALED from the io-loop thread (a GC-triggered release
+        # there must not block the loop on a connect).
+        from .rpc import NotifySideChannel
+
+        io_thread = threading.current_thread()  # we're on the io loop
+        self._side_channel = NotifySideChannel(
+            self.agent_addr,
+            avoid_dial=lambda: threading.current_thread() is io_thread)
 
     # ------------------------------------------------------------- helpers
     def _completion_event(self, oid: ObjectID) -> asyncio.Event:
@@ -476,6 +494,15 @@ class ClusterRuntime(BaseRuntime):
                     return
         self._release_object(object_id)
 
+    def mark_ref_escaped(self, oid: ObjectID) -> None:
+        """This ref left the process (pickled, or passed as a task
+        arg): another process may register a borrow, so the eager
+        local free in _release_object is off for it — only the
+        controller-driven release (which waits out borrowers) may
+        delete the primary copy."""
+        with self._refs_lock:
+            self._escaped_refs.add(oid)
+
     def _add_submitted_holds(self, oids: List[ObjectID]) -> None:
         """Pin args of an in-flight task (ref: reference_count.h
         submitted_task_ref_count) — `f.remote(g.remote())` drops the inner
@@ -483,6 +510,7 @@ class ClusterRuntime(BaseRuntime):
         the consuming task completes."""
         with self._refs_lock:
             for oid in oids:
+                self._escaped_refs.add(oid)
                 self._submitted_holds[oid] = \
                     self._submitted_holds.get(oid, 0) + 1
 
@@ -514,11 +542,87 @@ class ClusterRuntime(BaseRuntime):
             self._lineage.pop(oid, None)
             borrowed = oid in self._borrows_registered
             self._borrows_registered.discard(oid)
+            escaped = oid in self._escaped_refs
+            self._escaped_refs.discard(oid)
+            local_put = oid in self._local_puts
+            self._local_puts.discard(oid)
         if owned and plane:
-            self._notify_async("owner_release", {"object_id": oid})
+            if not escaped:
+                # Eager local free (ref: plasma's out-of-scope delete):
+                # the ref never left this process, so no borrower can
+                # exist — free the store bytes NOW so the allocator
+                # reuses the (hot) block, instead of waiting out the
+                # release round trip through the controller.  The
+                # directory entry still retires below; the store
+                # delete there becomes a no-op.
+                try:
+                    self.store.delete(oid)
+                except Exception:
+                    pass
+            if self._shutdown_flag:
+                return  # teardown owns cleanup; don't re-dial anything
+            if local_put and not escaped:
+                # Fast release: one NOTIFY to the local agent retires
+                # the directory entry + published locations — no
+                # controller owner_release/free_object round trip (no
+                # borrowers or induced borrows can exist for a
+                # never-pickled plain put).  Same-channel FIFO keeps
+                # it behind the object's own registration.
+                if self._side_channel.notify(
+                        "owner_release_local", {"object_id": oid}):
+                    return
+
+                def _fast_release():
+                    try:
+                        self._agent.notify_nowait(
+                            "owner_release_local", {"object_id": oid})
+                    except Exception:
+                        pass  # agent gone: node (and copy) is dying
+
+                self._bg_submit(_fast_release)
+            else:
+                self._notify_async("owner_release", {"object_id": oid})
         elif borrowed:
             self._notify_async("remove_borrower", {
                 "object_id": oid, "holder": self._runtime_id})
+
+    def _bg_submit(self, fn) -> None:
+        """Run ``fn`` on the event-loop thread, coalescing wakeups: a
+        burst of background ops (register/release per put in a tight
+        loop) pays ONE cross-thread self-pipe write while the loop is
+        still draining, not one per op — the wakeup send contends on
+        the GIL with the loop thread and was costing more than the ops
+        themselves.  FIFO order is preserved, so a register queued
+        before a release is written first."""
+        with self._bg_lock:
+            self._bg_ops.append(fn)
+            if self._bg_scheduled:
+                return
+            self._bg_scheduled = True
+        try:
+            self.io.call_soon(self._bg_drain)
+        except Exception:
+            # Loop stopped (shutdown race): drop the ops — matching
+            # the old fire-and-forget behavior — and unlatch so a
+            # later submit doesn't silently no-op forever.
+            with self._bg_lock:
+                self._bg_ops.clear()
+                self._bg_scheduled = False
+
+    def _bg_drain(self) -> None:
+        while True:
+            with self._bg_lock:
+                if not self._bg_ops:
+                    self._bg_scheduled = False
+                    return
+                # Swap, don't copy+clear: a GC-triggered re-entrant
+                # submit landing mid-copy would be wiped by clear().
+                ops, self._bg_ops = self._bg_ops, []
+            for fn in ops:
+                try:
+                    fn()
+                except Exception:
+                    pass
 
     def _notify_async(self, method: str, payload: Dict) -> None:
         """Fire-and-forget controller notification from any thread
@@ -526,7 +630,7 @@ class ClusterRuntime(BaseRuntime):
         if self._shutdown_flag:
             return
         try:
-            self.io.call_soon(lambda: self.io.loop.create_task(
+            self._bg_submit(lambda: self.io.loop.create_task(
                 self._notify_ignore_errors(method, payload)))
         except Exception:
             pass
@@ -2121,10 +2225,50 @@ class ClusterRuntime(BaseRuntime):
         with self._refs_lock:
             self._owned_ids.add(oid)
             self._owned_plane.add(oid)  # puts have no lineage (ref parity)
-        self.io.run(self._agent.call("register_object",
-                                     {"object_id": oid, "size": size}))
+            if not embedded:
+                # Eligible for the agent-local fast release: a plain
+                # put with no embedded refs has no induced borrows to
+                # cascade on the controller.
+                self._local_puts.add(oid)
+        # Fire-and-forget registration, written from THIS thread over
+        # the notify side channel — the sealed bytes are already
+        # readable locally (get() maps them directly) and remote pulls
+        # poll the directory with re-checks, so registration latency
+        # is absorbed; skipping the io-loop wakeup + round trip
+        # removes most of the driver-side cost of a large put.
+        if not self._side_channel.notify(
+                "register_object", {"object_id": oid, "size": size}):
+            # Side channel down: fall back to an ACKED call on the main
+            # agent connection — a notify here could be swallowed by a
+            # half-open socket's deferred flush, silently leaving the
+            # object unregistered (remote pulls would hang forever).
+            def _send_register():
+                def _check(f):
+                    if f.cancelled() or f.exception() is not None:
+                        asyncio.ensure_future(
+                            self._register_object_retry(oid, size))
+
+                try:
+                    self._agent.call_nowait(
+                        "register_object",
+                        {"object_id": oid, "size": size}
+                    ).add_done_callback(_check)
+                except Exception:
+                    # Not connected (reconnect window): full dial.
+                    asyncio.ensure_future(
+                        self._register_object_retry(oid, size))
+
+            self._bg_submit(_send_register)
         self.memory.put(oid, _StoreRef(size))
         return ObjectRef(oid)
+
+    async def _register_object_retry(self, oid: ObjectID,
+                                     size: int) -> None:
+        try:
+            await self._agent.call("register_object",
+                                   {"object_id": oid, "size": size})
+        except (RpcError, RemoteCallError):
+            pass  # agent gone: the node (and this copy) is dying anyway
 
     # Worker-role callback (set by worker_main): fired when the
     # executing task blocks/unblocks in get().
@@ -2149,9 +2293,21 @@ class ClusterRuntime(BaseRuntime):
             pass
 
     def _fetch_store_value(self, oid: ObjectID,
-                           timeout: Optional[float]) -> Any:
+                           timeout: Optional[float],
+                           size_hint: int = 0) -> Any:
         """Pull a plane object into the local node store and map it,
-        reconstructing from lineage if every copy was lost.  The map can
+        reconstructing from lineage if every copy was lost.
+
+        ``size_hint`` > 0 means the caller already knows the object's
+        packed size (a _StoreRef descriptor — our own put or a local
+        task result): try mapping the local store directly before
+        paying the agent pull round trip.  Both backends make the
+        direct read safe: the pool copies out under a cross-process
+        read pin, segment mappings stay valid past unlink.  A miss
+        (spilled, evicted, or produced on another node) falls through
+        to the normal pull, which restores/transfers the copy.
+
+        The map can
         race a spill/eviction in the window after the pull reply — a
         missing segment means re-pull (which restores), not data loss.
         A failed pull of an object WITH lineage is also retried: under
@@ -2159,6 +2315,11 @@ class ClusterRuntime(BaseRuntime):
         die in the window between reconstruction and this pull, which
         must mean "reconstruct again", not "not reconstructable"
         (round-3 VERDICT weak #1 interleaving)."""
+        if size_hint > 0:
+            try:
+                return self.store.get(oid, size_hint)
+            except (FileNotFoundError, OSError):
+                pass  # not local anymore: pull restores/transfers it
         for attempt in range(3):
             r = self.io.run(self._pull_with_recovery(oid, timeout))
             if not r.get("ok"):
@@ -2330,7 +2491,8 @@ class ClusterRuntime(BaseRuntime):
                 else:
                     val = self._fetch_store_value(r.id, remaining)
                 if isinstance(val, _StoreRef):
-                    val = self._fetch_store_value(r.id, remaining)
+                    val = self._fetch_store_value(r.id, remaining,
+                                                  size_hint=val.size)
                 if isinstance(val, TaskError):
                     raise val
                 out.append(val)
@@ -2576,6 +2738,10 @@ class ClusterRuntime(BaseRuntime):
                 except Exception:
                     pass
         finally:
+            try:
+                self._side_channel.close()
+            except Exception:
+                pass
             self.store.close()
             self.memory.clear()
             self.io.stop()

@@ -117,6 +117,13 @@ define_flag("controller_reconnect_grace_s", float, 30.0,
 define_flag("object_transfer_chunk_bytes", int, 4 * 1024**2,
             "Node-to-node object transfer chunk size; larger objects "
             "move as a sequence of chunk RPCs, not one giant frame.")
+define_flag("pull_parallelism", int, 8,
+            "Max concurrent chunk-fetch RPCs per chunked object pull. "
+            "A pull larger than object_transfer_chunk_bytes issues up "
+            "to this many fetch_chunk requests in flight (bounded "
+            "window = transfer backpressure); the source overlaps its "
+            "per-chunk store/disk reads with the wire, so large-block "
+            "ingest approaches line rate instead of one-chunk-per-RTT.")
 define_flag("object_store_backend", str, "pool",
             "Node object store backing: 'pool' (native C++ slab "
             "allocator over one shm region, src/shm_pool.cpp — the "

@@ -144,8 +144,8 @@ class Controller:
             "register_actor", "actor_started", "actor_died", "get_actor",
             "lookup_named_actor", "kill_actor", "worker_exited",
             "kv_put", "kv_get", "kv_del", "kv_keys", "kv_append", "kv_list",
-            "publish_locations", "remove_locations", "locate_object",
-            "locate_objects",
+            "publish_locations", "remove_locations", "update_locations",
+            "locate_object", "locate_objects",
             "free_object", "owner_release", "add_borrower",
             "remove_borrower", "link_induced_borrows",
             "poll_events", "register_job", "finish_job",
@@ -672,22 +672,39 @@ class Controller:
         return self._kv_items(p["key"])
 
     # -------------------------------------------------------- object plane
+    def _add_location(self, node_id, oid, size) -> None:
+        info = self._dir_entry(oid)  # merges with placeholder borrows
+        info["nodes"].add(node_id)
+        info["size"] = size
+
+    def _remove_location(self, node_id, oid) -> None:
+        info = self.object_dir.get(oid)
+        if info is not None:
+            info["nodes"].discard(node_id)
+            if not info["nodes"]:
+                self._drop_if_idle(oid)  # keep borrower/owner state
+
     async def publish_locations(self, p):
-        node_id = p["node_id"]
         for oid, size in p["objects"]:
-            info = self._dir_entry(oid)  # merges with placeholder borrows
-            info["nodes"].add(node_id)
-            info["size"] = size
+            self._add_location(p["node_id"], oid, size)
         return {"ok": True}
 
     async def remove_locations(self, p):
-        node_id = p["node_id"]
         for oid in p["objects"]:
-            info = self.object_dir.get(oid)
-            if info is not None:
-                info["nodes"].discard(node_id)
-                if not info["nodes"]:
-                    self._drop_if_idle(oid)  # keep borrower/owner state
+            self._remove_location(p["node_id"], oid)
+        return {"ok": True}
+
+    async def update_locations(self, p):
+        """Coalesced, ORDERED add/remove location updates from one
+        node's agent (the object plane's hot-path publication traffic,
+        batched agent-side so a burst of put/release cycles costs one
+        frame instead of one call round trip each)."""
+        node_id = p["node_id"]
+        for kind, item in p["updates"]:
+            if kind == "add":
+                self._add_location(node_id, item[0], item[1])
+            else:
+                self._remove_location(node_id, item)
         return {"ok": True}
 
     async def locate_objects(self, p):

@@ -141,6 +141,15 @@ class NodeAgent:
         self._idle_q: List[WorkerEntry] = []
         self._worker_ready = asyncio.Event()
         self._pull_inflight: Dict[ObjectID, asyncio.Future] = {}
+        # Fast releases that arrived before their registration (cross-
+        # channel reorder); the late register must be dropped.
+        self._early_released: set = set()
+        # Coalesced location updates -> controller (ordered add/remove
+        # pairs); flushed after a short window so a put/release burst
+        # costs one bulk notify, not a call round trip per object.
+        self._loc_buf: List = []
+        self._loc_flush_scheduled = False
+        self._loc_send_inflight = False
         self._ctl: Optional[RpcClient] = None
         self._peer_agents: Dict[str, RpcClient] = {}
         self._resource_view: Dict[Any, Dict] = {}
@@ -171,7 +180,7 @@ class NodeAgent:
             "jax_profile_workers",
             "task_blocked", "task_unblocked", "report_backlog",
             "register_object", "pull_object", "fetch_raw", "fetch_chunk",
-            "delete_object", "make_room",
+            "delete_object", "owner_release_local", "make_room",
             "object_exists", "objects_exist", "store_stats",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "restart_actor", "kill_worker", "report_actor_failure",
@@ -285,15 +294,16 @@ class NodeAgent:
         self._loop = asyncio.get_event_loop()
 
         def _on_evict(oids):
-            async def _publish():
-                try:
-                    await self._ctl.call("remove_locations", {
-                        "node_id": self.node_id, "objects": oids})
-                except RpcError:
-                    pass
+            # Through the ORDERED update queue (thread-safe hop onto
+            # the loop): an immediate direct remove could overtake a
+            # still-buffered add for the same oid and leave a ghost
+            # location — every location mutation from this agent rides
+            # one serialized, acked stream.
+            def _q():
+                for oid in oids:
+                    self._queue_loc_update("remove", oid)
 
-            self._loop.call_soon_threadsafe(
-                lambda: spawn_task(_publish()))
+            self._loop.call_soon_threadsafe(_q)
 
         self.directory.on_evict = _on_evict
         self._ctl = RpcClient(self.controller_addr,
@@ -1455,17 +1465,74 @@ class NodeAgent:
         LRU pressure can never delete the only live copy (ref:
         object_lifecycle_manager.h primary-copy pinning)."""
         oid, size = p["object_id"], p["size"]
+        if oid in self._early_released:
+            # The owner's fast release overtook this registration
+            # (different channels): registering now would create a
+            # ghost pinned entry nobody will ever delete.
+            self._early_released.discard(oid)
+            return {"ok": True}
         evicted = self.directory.register(
             oid, size, primary=p.get("primary", True))
-        try:
-            await self._ctl.call("publish_locations", {
-                "node_id": self.node_id, "objects": [(oid, size)]})
-            if evicted:
-                await self._ctl.call("remove_locations", {
-                    "node_id": self.node_id, "objects": evicted})
-        except RpcError:
-            pass
+        self._queue_loc_update("add", (oid, size))
+        for vid in evicted:
+            self._queue_loc_update("remove", vid)
         return {"ok": True}
+
+    def _queue_loc_update(self, kind: str, item) -> None:
+        """Buffer one ordered location add/remove for the controller;
+        a short flush window coalesces a put/release burst into one
+        bulk notify (pull discovery polls with >=20 ms backoff, so a
+        5 ms publication delay is invisible — but ~4 control frames
+        per object put become amortized to ~zero)."""
+        self._loc_buf.append((kind, item))
+        if not self._loc_flush_scheduled:
+            self._loc_flush_scheduled = True
+            asyncio.get_event_loop().call_later(0.005, self._loc_flush)
+
+    def _loc_flush(self) -> None:
+        self._loc_flush_scheduled = False
+        if self._loc_send_inflight or not self._loc_buf:
+            # One acked send in flight at a time: concurrent sends
+            # could complete out of order across a reconnect and
+            # replay an "add" after its "remove" (ghost entry).
+            return
+        updates, self._loc_buf = self._loc_buf, []
+        self._loc_send_inflight = True
+
+        def _reschedule(delay: float) -> None:
+            if not self._loc_flush_scheduled:
+                self._loc_flush_scheduled = True
+                asyncio.get_event_loop().call_later(
+                    delay, self._loc_flush)
+
+        async def _send():
+            try:
+                await asyncio.wait_for(
+                    self._ctl.call("update_locations", {
+                        "node_id": self.node_id, "updates": updates}),
+                    10.0)
+            except (RpcError, asyncio.TimeoutError):
+                # Controller reconnect window: REQUEUE (ordered, at the
+                # head) and retry after a beat — a dropped batch would
+                # permanently hide these copies from cross-node gets
+                # (plain puts have no lineage to reconstruct from).
+                # Duplicate replays are idempotent controller-side.
+                self._loc_buf[0:0] = updates
+                if len(self._loc_buf) > 100_000:
+                    dropped = len(self._loc_buf) - 100_000
+                    del self._loc_buf[:dropped]
+                    logger.warning(
+                        "location-update backlog overflow: dropped %d "
+                        "oldest updates during controller outage — "
+                        "some copies may stay unpublished", dropped)
+                self._loc_send_inflight = False
+                _reschedule(0.5)
+                return
+            self._loc_send_inflight = False
+            if self._loc_buf:
+                _reschedule(0.005)
+
+        asyncio.ensure_future(_send())
 
     async def objects_exist(self, p):
         """Bulk local-directory probe (wait() fallback for objects whose
@@ -1560,17 +1627,13 @@ class NodeAgent:
                     if n is None:
                         continue
                     # Pulled replica = secondary copy, LRU-evictable.
+                    # Publication rides the ordered update queue so it
+                    # can never be overtaken by (or overtake) another
+                    # path's add/remove for the same oid.
                     evicted = self.directory.register(oid, n)
-                    try:
-                        await self._ctl.call("publish_locations", {
-                            "node_id": self.node_id,
-                            "objects": [(oid, n)]})
-                        if evicted:
-                            await self._ctl.call("remove_locations", {
-                                "node_id": self.node_id,
-                                "objects": evicted})
-                    except RpcError:
-                        pass
+                    self._queue_loc_update("add", (oid, n))
+                    for vid in evicted:
+                        self._queue_loc_update("remove", vid)
                     return {"ok": True, "size": n}
             # Re-check local (producer may have just sealed here).
             ent = self.directory.lookup(oid)
@@ -1618,24 +1681,55 @@ class NodeAgent:
         """Assemble a large object from bounded chunk RPCs, then seal it
         locally (ref: pull_manager.h:52 chunked object reads — chunking
         bounds the per-RPC frame, so no giant pickle frame ever crosses
-        the wire).  Assembly happens in a host buffer, NOT directly in
-        the destination segment: on a shared-/dev/shm test topology the
+        the wire).  Up to ``pull_parallelism`` chunk fetches ride the
+        wire concurrently (a fixed worker pool over the offset sequence
+        — the pool size IS the in-flight window, so backpressure is
+        structural): the source overlaps its per-chunk store/disk reads
+        across executor threads while earlier chunks are in transit,
+        instead of paying one RTT + one read per chunk serially.
+        Assembly happens in a host buffer, NOT directly in the
+        destination segment: on a shared-/dev/shm test topology the
         destination name aliases the source segment, and an in-place
         create would clobber the bytes mid-read.  Returns the byte
         count, or None if the source lost its copy."""
         buf = bytearray(size)
-        offset = 0
-        while offset < size:
-            length = min(chunk, size - offset)
-            r = await cli.call("fetch_chunk", {
-                "object_id": oid, "offset": offset, "length": length})
-            if r is None:
-                return None
-            data = r["data"]
-            buf[offset:offset + len(data)] = data
-            offset += len(data)
-            if len(data) < length:
-                return None  # source shrank?! treat as lost
+        offsets = iter(range(0, size, chunk))
+        lost = False
+        failure: Optional[BaseException] = None
+
+        async def _fetch_worker():
+            nonlocal lost, failure
+            # Plain-iterator next() is atomic per worker turn (no await
+            # between take and use), so offsets are claimed exactly once.
+            for offset in offsets:
+                if lost or failure is not None:
+                    return  # a sibling failed: stop claiming chunks
+                length = min(chunk, size - offset)
+                try:
+                    r = await cli.call("fetch_chunk", {
+                        "object_id": oid, "offset": offset,
+                        "length": length})
+                except BaseException as e:  # noqa: BLE001 — re-raised
+                    failure = e
+                    return
+                if r is None or len(r["data"]) < length:
+                    lost = True  # copy vanished / source shrank
+                    return
+                buf[offset:offset + length] = r["data"]
+
+        window = max(1, int(getattr(self.config, "pull_parallelism", 1)))
+        n_chunks = (size + chunk - 1) // chunk
+        workers = [asyncio.ensure_future(_fetch_worker())
+                   for _ in range(min(window, n_chunks))]
+        try:
+            await asyncio.gather(*workers)
+        finally:
+            for w in workers:
+                w.cancel()
+        if failure is not None:
+            raise failure  # RpcError -> caller tries the next location
+        if lost:
+            return None
         self.store.put_raw(oid, memoryview(buf))
         return size
 
@@ -1692,6 +1786,25 @@ class NodeAgent:
 
     async def delete_object(self, p):
         self.directory.delete(p["object_id"])
+
+    async def owner_release_local(self, p):
+        """Fast-path release from a local owner for a never-shared
+        object (plain put whose ref was never pickled): the owner
+        already freed the store bytes (eager local free); retire the
+        directory entry and the published locations WITHOUT the
+        controller owner_release/free_object round trip — no borrower
+        or induced borrow can exist for it."""
+        oid = p["object_id"]
+        if self.directory.delete(oid):
+            self._queue_loc_update("remove", oid)
+        else:
+            # Release overtook the registration (side channel vs main
+            # connection): flag it so the late register is dropped
+            # instead of resurrecting a ghost entry.  Bounded.
+            self._early_released.add(oid)
+            while len(self._early_released) > 4096:
+                self._early_released.pop()
+        return {"ok": True}
 
     async def store_stats(self, _p):
         n, used, cap = self.directory.stats()

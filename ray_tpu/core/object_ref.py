@@ -105,6 +105,16 @@ class ObjectRef:
         # re-registers the borrow on deserialization (see worker context).
         if _collector.active is not None:
             _collector.active.append(self.id)
+        # A pickled ref can reach another process and grow borrowers:
+        # it is no longer eligible for the owner's eager local free
+        # (cluster_runtime._release_object fast path).
+        from . import runtime
+
+        rt = runtime.get_runtime_quiet()
+        if rt is not None:
+            mark = getattr(rt, "mark_ref_escaped", None)
+            if mark is not None:
+                mark(self.id)
         return (ObjectRef, (self.id, self._owner, self._in_band))
 
     def future(self):

@@ -115,6 +115,7 @@ class SharedObjectStore:
         Objects are immutable, but a retry after a mid-write crash (or two
         single-machine 'nodes' sharing /dev/shm) can hit an existing name;
         unlink+recreate keeps old mappings valid for in-flight readers."""
+        self.release(oid)  # a re-created name must not serve a stale map
         name = _segment_name(self._session, oid)
         try:
             seg = shared_memory.SharedMemory(name=name, create=True,
@@ -130,8 +131,13 @@ class SharedObjectStore:
         return seg
 
     # -- consumer side ------------------------------------------------------
-    def get(self, oid: ObjectID, size: int) -> Any:
-        """Map the segment and deserialize (zero-copy for array spans)."""
+    def _map(self, oid: ObjectID) -> shared_memory.SharedMemory:
+        """Map a segment through the per-process cache: repeated reads
+        of one object (chunked sends are many slice reads of the same
+        segment) reuse a single mapping instead of paying an
+        shm_open+mmap per call.  Cached mappings are dropped by
+        release()/delete()/close(); an unlinked segment's mapping stays
+        valid for in-flight readers (POSIX unlink semantics)."""
         with self._lock:
             seg = self._mapped.get(oid)
             if seg is None:
@@ -139,29 +145,39 @@ class SharedObjectStore:
                     name=_segment_name(self._session, oid))
                 _untrack(seg.name)
                 self._mapped[oid] = seg
-        return serialization.unpack(seg.buf[:size])
+        return seg
+
+    def _read_mapped(self, oid: ObjectID, fn):
+        """Run ``fn(seg)`` against the cached mapping, absorbing the
+        race where a concurrent delete()/release() closed the cached
+        SharedMemory between _map() and the .buf access (ValueError on
+        a closed mmap): retry once on a fresh mapping, and surface a
+        clean FileNotFoundError — the 'copy vanished' signal readers
+        already handle — if the segment is truly gone."""
+        try:
+            return fn(self._map(oid))
+        except ValueError:
+            self.release(oid)
+            try:
+                return fn(self._map(oid))
+            except ValueError:
+                raise FileNotFoundError(oid.hex()) from None
+
+    def get(self, oid: ObjectID, size: int) -> Any:
+        """Map the segment and deserialize (zero-copy for array spans)."""
+        return self._read_mapped(
+            oid, lambda seg: serialization.unpack(seg.buf[:size]))
 
     def read_raw(self, oid: ObjectID, size: int) -> bytes:
         """Copy out packed bytes (object transfer send path)."""
-        seg = shared_memory.SharedMemory(
-            name=_segment_name(self._session, oid))
-        _untrack(seg.name)
-        try:
-            return bytes(seg.buf[:size])
-        finally:
-            seg.close()
+        return self._read_mapped(oid, lambda seg: bytes(seg.buf[:size]))
 
     def read_raw_slice(self, oid: ObjectID, offset: int,
                        length: int) -> bytes:
         """One chunk of the packed bytes (chunked transfer send path,
         ref: push_manager/ObjectBufferPool chunk reads)."""
-        seg = shared_memory.SharedMemory(
-            name=_segment_name(self._session, oid))
-        _untrack(seg.name)
-        try:
-            return bytes(seg.buf[offset:offset + length])
-        finally:
-            seg.close()
+        return self._read_mapped(
+            oid, lambda seg: bytes(seg.buf[offset:offset + length]))
 
 
     def contains(self, oid: ObjectID) -> bool:

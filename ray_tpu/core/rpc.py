@@ -541,6 +541,99 @@ class RpcClient:
         self._fail_pending(RpcError("client closed"))
 
 
+class NotifySideChannel:
+    """A lock-guarded blocking socket that writes NOTIFY frames
+    straight from the calling thread — no event-loop hop.
+
+    The per-put control notifications (register_object,
+    owner_release_local) are tiny fire-and-forget frames, but routing
+    them through the io thread costs a call_soon_threadsafe self-pipe
+    wakeup that convoys on the GIL with the loop's own work — measured
+    at ~0.6 ms per wakeup on a busy driver, dwarfing the 4 MB memcpys
+    it accompanies.  Writing the frame here is ~20 µs: encode + one
+    sendall into the kernel buffer.  The server treats this like any
+    connection; we never read from it (notifies have no replies).
+
+    Delivery ordering holds per channel (one TCP stream); cross-channel
+    ordering vs the main RPC connection is NOT guaranteed — only use
+    this for notifications that tolerate reordering against call
+    traffic (the object plane's pull path polls and re-checks).
+    Any failure returns False; the caller falls back to the io-loop
+    path (which owns dialing/backoff).
+    """
+
+    def __init__(self, address: str,
+                 avoid_dial: Optional[Callable[[], bool]] = None):
+        self.address = address
+        self._sock = None
+        self._closed = False
+        self._down_until = 0.0
+        # Caller-supplied predicate: when true (e.g. running on the
+        # io-loop thread via a GC-triggered __del__), never DIAL here —
+        # a blocking connect on the loop thread would stall all RPC
+        # traffic.  Established-socket sends are bounded and fine.
+        self._avoid_dial = avoid_dial
+        # RLock + a per-thread busy flag: notify() is reachable from
+        # ObjectRef.__del__, so a cyclic-GC run triggered by an
+        # allocation INSIDE the locked region (create_connection) can
+        # re-enter on the same thread — a plain Lock would self-
+        # deadlock.  Re-entrant calls bail to the io-loop fallback.
+        self._lock = threading.RLock()
+        self._tl = threading.local()
+
+    def notify(self, method: str, payload: Any) -> bool:
+        import socket as _socket
+        import time as _time
+
+        if self._closed or getattr(self._tl, "busy", False):
+            return False  # closed, or re-entered from GC mid-send
+        if self._sock is None:
+            # Dial backoff: after a failure, fail fast to the io-loop
+            # fallback for a beat instead of paying a connect timeout
+            # on every release in a burst.
+            if _time.monotonic() < self._down_until:
+                return False
+            if self._avoid_dial is not None and self._avoid_dial():
+                return False
+        # C pickler: these hot-path payloads are plain dicts of ids —
+        # no driver-__main__ objects that need cloudpickle.
+        frame = _encode_frame_fast((_NOTIFY, 0, method, payload))
+        with self._lock:
+            self._tl.busy = True
+            try:
+                if self._closed:
+                    return False
+                if self._sock is None:
+                    host, port = self.address.rsplit(":", 1)
+                    self._sock = _socket.create_connection(
+                        (host, int(port)), timeout=2.0)
+                    self._sock.setsockopt(_socket.IPPROTO_TCP,
+                                          _socket.TCP_NODELAY, 1)
+                self._sock.sendall(frame)
+                return True
+            except OSError:
+                try:
+                    if self._sock is not None:
+                        self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                self._down_until = _time.monotonic() + 1.0
+                return False
+            finally:
+                self._tl.busy = False
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True  # latched: a post-shutdown GC'd ref
+            if self._sock is not None:  # must never re-dial from here
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
 class EventLoopThread:
     """A dedicated event-loop thread for synchronous processes (the driver
     and task-executing workers), mirroring how the reference keeps the

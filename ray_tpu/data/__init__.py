@@ -16,6 +16,7 @@ from .aggregate import (AggregateFn, Count, Max, Mean, Min,  # noqa: F401
 from .block import Block, BlockAccessor, build_block  # noqa: F401
 from .dataset import Dataset  # noqa: F401
 from .grouped_data import GroupedData  # noqa: F401
+from .iterator import DataIterator  # noqa: F401
 
 
 def from_items(items: List[Any], *, parallelism: int = 4) -> Dataset:

@@ -294,6 +294,11 @@ class Dataset:
         self._materialized: Optional[List[Block]] = None
         self._plan = plan or read_op(len(sources))
         self._limit = limit
+        # Node-affinity hint (hex node id) for block tasks: set by
+        # streaming_split(locality_hints=...) so a shard's blocks
+        # materialize on the consuming host and the consumer's pulls
+        # are local-store maps, not cross-node transfers.
+        self._locality_node: Optional[str] = None
 
     # --------------------------------------------------------- transforms
     def _with_op(self, op: _Op) -> "Dataset":
@@ -412,6 +417,16 @@ class Dataset:
             serialization.ensure_code_portable(op.fn)
         ctx = DataContext.get_current()
         remote_fn = ray_tpu.remote(_process_block)
+        if self._locality_node:
+            from ..util.scheduling_strategies import (
+                NodeAffinitySchedulingStrategy)
+
+            # Soft affinity: blocks materialize on the consuming host
+            # when it has capacity, but a busy/dead hint never stalls
+            # the pipeline.
+            remote_fn = remote_fn.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    self._locality_node, soft=True))
         inflight: List[Any] = []
         pending = list(self._sources)
         est = float(ctx.initial_block_size_estimate)
@@ -503,9 +518,21 @@ class Dataset:
         ahead on a background thread so a training step's host time
         overlaps the next blocks' task execution + object-plane pulls
         (ref: iterator.py prefetch_batches in the reference — the
-        consumer-side half of streaming execution)."""
+        consumer-side half of streaming execution).
+
+        Columnar formats (numpy/pandas/arrow) assemble batches by
+        SLICING block columns — no per-row Python materialization; a
+        batch that falls inside one block is a set of O(1) column
+        slices, and only batches straddling a block boundary pay one
+        concatenate over the carried remainder (ref: the reference's
+        batcher slicing Arrow blocks).  ``batch_format=None``/"rows"
+        keeps the row-list path."""
         blocks = (self._iter_blocks() if prefetch_blocks <= 0
                   else self._iter_blocks_prefetched(prefetch_blocks))
+        if batch_format in ("numpy", "pandas", "arrow"):
+            yield from self._iter_batches_columnar(
+                blocks, batch_size, batch_format, drop_last)
+            return
         buf: List[Any] = []
         for block in blocks:
             buf.extend(BlockAccessor.for_block(block).iter_rows())
@@ -515,50 +542,80 @@ class Dataset:
         if buf and not drop_last:
             yield self._format_batch(buf, batch_format)
 
+    @staticmethod
+    def _iter_batches_columnar(blocks: Iterator[Block], batch_size: int,
+                               batch_format: str,
+                               drop_last: bool) -> Iterator[Any]:
+        """Vectorized batch assembly over per-block column dicts with a
+        carry-over remainder buffer.  Each block converts to columns
+        ONCE (zero-copy for tensor-batch blocks); whole batches inside
+        a block are views, and the remainder carries forward as column
+        slices that concatenate only when the next batch completes.
+
+        numpy batches are marked READ-ONLY: they may alias block
+        columns shared with neighboring batches (and with later epochs
+        of a materialized dataset), so an in-place mutation must be a
+        loud ValueError, not silent data corruption — callers that
+        need to mutate should ``.copy()`` the column first."""
+        import numpy as np
+
+        carry: List[Dict[str, Any]] = []   # remainder column slices
+        carry_rows = 0
+
+        def emit(cols: Dict[str, Any]):
+            if batch_format == "numpy":
+                for v in cols.values():
+                    try:
+                        v.flags.writeable = False
+                    except (AttributeError, ValueError):
+                        pass  # non-array / already locked by its base
+                return cols
+            acc = BlockAccessor.for_block(dict(cols))
+            return (acc.to_pandas() if batch_format == "pandas"
+                    else acc.to_arrow())
+
+        def concat(parts: List[Dict[str, Any]]) -> Dict[str, Any]:
+            if len(parts) == 1:
+                return parts[0]
+            return {k: np.concatenate([p[k] for p in parts])
+                    for k in parts[0]}
+
+        for block in blocks:
+            acc = BlockAccessor.for_block(block)
+            n = acc.num_rows()
+            if n == 0:
+                continue
+            cols = acc.to_numpy_batch()
+            start = 0
+            if carry_rows:
+                need = batch_size - carry_rows
+                if n < need:
+                    carry.append(cols)
+                    carry_rows += n
+                    continue
+                carry.append({k: v[:need] for k, v in cols.items()})
+                yield emit(concat(carry))
+                carry, carry_rows = [], 0
+                start = need
+            while start + batch_size <= n:
+                yield emit({k: v[start:start + batch_size]
+                            for k, v in cols.items()})
+                start += batch_size
+            if start < n:
+                carry = [{k: v[start:] for k, v in cols.items()}]
+                carry_rows = n - start
+        if carry_rows and not drop_last:
+            yield emit(concat(carry))
+
     def _iter_blocks_prefetched(self, depth: int) -> Iterator[Block]:
         """Background-thread block prefetcher with a bounded queue —
-        the queue depth is the backpressure window."""
-        import queue as _queue
-        import threading as _threading
+        the queue depth is the backpressure window.  Shares the feeder
+        lifecycle (stop/drain/join on abandonment) with the device
+        prefetcher via util.prefetch."""
+        from ..util.prefetch import iter_prefetched
 
-        q: "_queue.Queue" = _queue.Queue(maxsize=max(depth, 1))
-        _END = object()
-        stop = _threading.Event()
-
-        def _put(item) -> bool:
-            # Bounded put that aborts on stop: a consumer that drops
-            # the iterator mid-stream must not leave this thread
-            # blocked on a full queue forever.
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.25)
-                    return True
-                except _queue.Full:
-                    continue
-            return False
-
-        def _feed():
-            try:
-                for b in self._iter_blocks():
-                    if not _put(b):
-                        return
-                _put(_END)
-            except BaseException as e:  # noqa: BLE001 — re-raised below
-                _put(e)
-
-        t = _threading.Thread(target=_feed, daemon=True,
-                              name="rt-data-prefetch")
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is _END:
-                    return
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-        finally:
-            stop.set()
+        return iter_prefetched(self._iter_blocks(), depth=depth,
+                               thread_name="rt-data-prefetch")
 
     @staticmethod
     def _format_batch(rows: List[Any], batch_format: str):
@@ -655,6 +712,54 @@ class Dataset:
         if self._has_runtime():
             return self._split_remote(n, equal)
         return self._split_local(n, equal)
+
+    def streaming_split(self, n: int, *,
+                        locality_hints: Optional[List[Optional[str]]]
+                        = None) -> List["DataIterator"]:
+        """Split into ``n`` per-consumer streaming iterators WITHOUT
+        materializing anything: shard i takes source blocks i, i+n,
+        i+2n, ... with the op chain intact, and streams them through
+        its own bounded execution window when iterated (ref:
+        Dataset.streaming_split + the streaming-split coordinator in
+        the reference).
+
+        ``locality_hints`` is an optional length-``n`` list of node ids
+        (hex, as in ``ray_tpu.nodes()[i]["NodeID"]`` or
+        ``get_runtime_context().get_node_id()``); shard i's block tasks
+        carry a node-affinity hint for that node, so blocks materialize
+        on the host that consumes them and the consumer's pulls are
+        local shared-memory maps instead of cross-node transfers.
+        Hints are best-effort: an unknown/dead node id falls back to
+        normal scheduling.
+        """
+        if n <= 0:
+            raise ValueError("streaming_split needs n >= 1")
+        if locality_hints is not None and len(locality_hints) != n:
+            raise ValueError(
+                f"locality_hints must have length {n}, got "
+                f"{len(locality_hints)}")
+        from .iterator import DataIterator
+
+        if self._limit is not None:
+            # A limit is a stage boundary: shards must cover the
+            # LIMITED rows.  Without a runtime, materialize the
+            # limited prefix inline (mirrors split()).
+            base = (self._freeze_limit() if self._has_runtime()
+                    else Dataset._from_materialized(
+                        list(self._iter_blocks()), self._window))
+        else:
+            base = self
+        shards: List[DataIterator] = []
+        for i in range(n):
+            if base._materialized is not None:
+                d = Dataset._from_materialized(
+                    base._materialized[i::n], base._window)
+            else:
+                d = Dataset(base._sources[i::n], base._ops,
+                            base._window)
+            hint = locality_hints[i] if locality_hints else None
+            shards.append(DataIterator(d, locality_node=hint))
+        return shards
 
     def _split_remote(self, n: int, equal: bool) -> List["Dataset"]:
         import ray_tpu

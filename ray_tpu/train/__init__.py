@@ -14,7 +14,8 @@ from .config import (CheckpointConfig, FailureConfig, Result,  # noqa
 from .session import (checkpoint_dir, checkpoint_on_notice,  # noqa
                       data_wait, get_checkpoint, get_dataset_shard,
                       get_local_rank, get_world_rank, get_world_size,
-                      interrupted, interruption, report)
+                      interrupted, interruption, iter_device_batches,
+                      report)
 from .trainer import (DataParallelTrainer, JaxTrainer,  # noqa: F401
                       TorchTrainer)
 from .worker_group import PreemptionError, WorkerGroup  # noqa: F401

@@ -44,7 +44,13 @@ FLOORS: Dict[str, "tuple[float, int]"] = {
     "micro/actor_calls_sequential": (400.0, 5),
     "micro/actor_calls_batch": (3000.0, 6),
     "micro/put_get_small": (300.0, 5),
-    "micro/put_get_4mb": (100.0, 5),
+    # r6 zero-stall ingest PR: the 4 MB put/get floor is 1.5x the r5
+    # RECORD (436.7 ops/s) — direct local-store reads, notify-side-
+    # channel registration, eager local free, and coalesced location
+    # updates lift the measured rate to ~800 ops/s on the 1-core CI
+    # box; 655 keeps headroom for noisy-neighbor phases while pinning
+    # the improvement.
+    "micro/put_get_4mb": (655.0, 6),
     "scale/many_tasks_inflight_10000": (1000.0, 5),
     "scale/queue_submit_100000": (3000.0, 5),
     "scale/many_actors_50": (0.5, 5),

@@ -1,0 +1,365 @@
+"""Zero-stall input pipeline units: vectorized iter_batches equivalence
+vs the row-wise path, streaming_split sharding, windowed parallel chunk
+pulls, device prefetch, and feeder-thread hygiene.
+
+Ref: tf.data-style vectorized batching + prefetch (Murray et al. 2021),
+the reference's Batcher/DataIterator and pull_manager chunked reads.
+"""
+
+import asyncio
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from ray_tpu.data.block import BlockAccessor, build_block
+from ray_tpu.data.dataset import Dataset
+
+
+# ------------------------------------------------------------------ helpers
+def _row_wise_batches(ds, batch_size, batch_format, drop_last):
+    """The pre-vectorization reference implementation: explode blocks
+    into row lists, slice per batch, rebuild a block per batch."""
+    buf = []
+    out = []
+    for block in ds._iter_blocks():
+        buf.extend(BlockAccessor.for_block(block).iter_rows())
+        while len(buf) >= batch_size:
+            chunk, buf = buf[:batch_size], buf[batch_size:]
+            out.append(Dataset._format_batch(chunk, batch_format))
+    if buf and not drop_last:
+        out.append(Dataset._format_batch(buf, batch_format))
+    return out
+
+
+def _scalar_dataset(sizes):
+    """Blocks of dict rows {"id": int, "x": float} with given sizes."""
+    blocks, n = [], 0
+    for s in sizes:
+        blocks.append(build_block(
+            [{"id": n + j, "x": float(n + j) / 2} for j in range(s)]))
+        n += s
+    return Dataset._from_materialized(blocks, 4)
+
+
+def _tensor_dataset(sizes, width=3):
+    blocks, n = [], 0
+    for s in sizes:
+        ids = np.arange(n, n + s)
+        blocks.append({"id": ids,
+                       "vec": np.stack([np.full(width, i, np.float32)
+                                        for i in ids])})
+        n += s
+    return Dataset._from_materialized(blocks, 4)
+
+
+def _assert_numpy_batches_equal(got, want):
+    assert len(got) == len(want), (len(got), len(want))
+    for g, w in zip(got, want):
+        assert set(g) == set(w)
+        for k in w:
+            assert np.asarray(g[k]).tolist() == \
+                np.asarray(w[k]).tolist(), k
+
+
+# ------------------------------------------------- vectorized equivalence
+@pytest.mark.parametrize("sizes,batch_size", [
+    ([7, 5, 9], 4),    # remainders straddle every boundary
+    ([8, 8], 4),       # exact division inside blocks
+    ([3, 1, 2], 10),   # batch larger than any block (multi-block carry)
+    ([5], 2),          # single block + remainder
+])
+@pytest.mark.parametrize("drop_last", [False, True])
+def test_vectorized_numpy_matches_row_wise(sizes, batch_size, drop_last):
+    ds = _scalar_dataset(sizes)
+    got = list(ds.iter_batches(batch_size=batch_size,
+                               batch_format="numpy",
+                               drop_last=drop_last))
+    want = _row_wise_batches(ds, batch_size, "numpy", drop_last)
+    _assert_numpy_batches_equal(got, want)
+    # Order: ids must be globally increasing across batches.
+    flat = [i for b in got for i in np.asarray(b["id"]).tolist()]
+    assert flat == sorted(flat)
+
+
+@pytest.mark.parametrize("drop_last", [False, True])
+def test_vectorized_tensor_blocks_match_row_wise(drop_last):
+    ds = _tensor_dataset([6, 4, 7], width=3)
+    got = list(ds.iter_batches(batch_size=5, batch_format="numpy",
+                               drop_last=drop_last))
+    want = _row_wise_batches(ds, 5, "numpy", drop_last)
+    _assert_numpy_batches_equal(got, want)
+    for b in got:
+        assert b["vec"].shape[1:] == (3,)
+
+
+def test_vectorized_pandas_matches_row_wise():
+    ds = _scalar_dataset([7, 6])
+    got = list(ds.iter_batches(batch_size=5, batch_format="pandas"))
+    want = _row_wise_batches(ds, 5, "pandas", False)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert list(g.columns) == list(w.columns)
+        for c in w.columns:
+            assert g[c].tolist() == w[c].tolist()
+
+
+def test_vectorized_arrow_and_rows_formats():
+    ds = _scalar_dataset([4, 4])
+    arrow = list(ds.iter_batches(batch_size=3, batch_format="arrow"))
+    assert [t.num_rows for t in arrow] == [3, 3, 2]
+    rows = list(ds.iter_batches(batch_size=3, batch_format="rows"))
+    assert rows[0][0] == {"id": 0, "x": 0.0}
+
+
+def test_vectorized_scalar_value_rows():
+    """Non-dict rows batch as a 'value' column, same as the row path."""
+    ds = Dataset._from_materialized([[1, 2, 3], [4, 5]], 4)
+    got = list(ds.iter_batches(batch_size=2, batch_format="numpy"))
+    want = _row_wise_batches(ds, 2, "numpy", False)
+    _assert_numpy_batches_equal(got, want)
+
+
+def test_vectorized_batches_are_views_inside_blocks():
+    """A batch that falls inside one tensor block is a zero-copy view
+    of the block's columns — the point of vectorized assembly.  The
+    views are read-only (they alias data shared with other batches);
+    the source block itself stays writable."""
+    ds = _tensor_dataset([8], width=2)
+    block = ds._materialized[0]
+    batches = list(ds.iter_batches(batch_size=4, batch_format="numpy"))
+    assert batches[0]["vec"].base is block["vec"]
+    assert not batches[0]["vec"].flags.writeable
+    with pytest.raises(ValueError):
+        batches[0]["vec"][0, 0] = 99.0     # loud, not silent corruption
+    assert block["vec"].flags.writeable    # source block untouched
+
+
+# ------------------------------------------------------- streaming_split
+def test_streaming_split_shards_cover_all_rows():
+    ds = _scalar_dataset([4, 4, 4, 4, 4])
+    shards = ds.streaming_split(2)
+    assert [s.num_blocks() for s in shards] == [3, 2]
+    seen = []
+    for s in shards:
+        for b in s.iter_batches(batch_size=3, prefetch_blocks=0):
+            seen.extend(np.asarray(b["id"]).tolist())
+    assert sorted(seen) == list(range(20))
+
+
+def test_streaming_split_validates_hints():
+    ds = _scalar_dataset([4, 4])
+    with pytest.raises(ValueError):
+        ds.streaming_split(2, locality_hints=["onlyone"])
+    with pytest.raises(ValueError):
+        ds.streaming_split(0)
+    it = ds.streaming_split(2, locality_hints=["aa" * 16, None])[0]
+    assert it.locality_node == "aa" * 16
+
+
+# ------------------------------------------- feeder thread hygiene (b)
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("rt-data-prefetch") and t.is_alive()]
+
+
+def test_abandoned_prefetch_iterator_joins_feeder():
+    ds = _scalar_dataset([8] * 6)
+    it = ds.iter_batches(batch_size=4, prefetch_blocks=1)
+    next(it)            # feeder running, queue full
+    it.close()          # abandon mid-stream -> finally must join
+    assert _prefetch_threads() == []
+
+
+def test_exhausted_prefetch_iterator_joins_feeder():
+    ds = _scalar_dataset([4, 4])
+    assert len(list(ds.iter_batches(batch_size=4,
+                                    prefetch_blocks=2))) == 2
+    assert _prefetch_threads() == []
+
+
+# ------------------------------------------- windowed parallel pulls (2)
+class _FakeChunkSource:
+    """Stub peer RpcClient: serves fetch_chunk from a byte payload and
+    records the concurrency of in-flight requests."""
+
+    def __init__(self, payload, delay=0.005, fail_at=None,
+                 raise_at=None):
+        self.payload = payload
+        self.delay = delay
+        self.fail_at = fail_at      # offset -> return None (copy lost)
+        self.raise_at = raise_at    # offset -> raise RpcError
+        self.inflight = 0
+        self.max_inflight = 0
+        self.calls = 0
+
+    async def call(self, method, p):
+        assert method == "fetch_chunk"
+        self.calls += 1
+        self.inflight += 1
+        self.max_inflight = max(self.max_inflight, self.inflight)
+        try:
+            await asyncio.sleep(self.delay)
+            off, ln = p["offset"], p["length"]
+            if self.raise_at == off:
+                from ray_tpu.core.rpc import RpcError
+
+                raise RpcError("conn dropped")
+            if self.fail_at == off:
+                return None
+            return {"data": self.payload[off:off + ln],
+                    "size": len(self.payload)}
+        finally:
+            self.inflight -= 1
+
+
+class _CaptureStore:
+    def __init__(self):
+        self.raw = None
+
+    def put_raw(self, oid, data):
+        self.raw = bytes(data)
+        return len(self.raw)
+
+
+def _fake_agent(parallelism):
+    from ray_tpu.core.node_agent import NodeAgent
+
+    self = types.SimpleNamespace(
+        config=types.SimpleNamespace(pull_parallelism=parallelism),
+        store=_CaptureStore())
+    return self, NodeAgent._pull_chunked
+
+
+def test_pull_chunked_parallel_window_and_integrity():
+    rng = np.random.default_rng(7)
+    payload = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    cli = _FakeChunkSource(payload)
+    fake, pull = _fake_agent(parallelism=4)
+    n = asyncio.run(pull(fake, cli, "oid", len(payload), 64 * 1024))
+    assert n == len(payload)
+    assert fake.store.raw == payload          # byte-identical reassembly
+    assert cli.calls == 16
+    assert cli.max_inflight > 1               # actually parallel
+    assert cli.max_inflight <= 4              # bounded window
+
+
+def test_pull_chunked_window_one_is_serial():
+    payload = bytes(range(256)) * 1024
+    cli = _FakeChunkSource(payload, delay=0.001)
+    fake, pull = _fake_agent(parallelism=1)
+    n = asyncio.run(pull(fake, cli, "oid", len(payload), 32 * 1024))
+    assert n == len(payload) and fake.store.raw == payload
+    assert cli.max_inflight == 1
+
+
+def test_pull_chunked_lost_copy_returns_none():
+    payload = b"x" * (256 * 1024)
+    cli = _FakeChunkSource(payload, fail_at=128 * 1024)
+    fake, pull = _fake_agent(parallelism=4)
+    n = asyncio.run(pull(fake, cli, "oid", len(payload), 64 * 1024))
+    assert n is None
+    assert fake.store.raw is None             # nothing sealed
+
+def test_pull_chunked_rpc_error_propagates():
+    from ray_tpu.core.rpc import RpcError
+
+    payload = b"y" * (256 * 1024)
+    cli = _FakeChunkSource(payload, raise_at=64 * 1024)
+    fake, pull = _fake_agent(parallelism=4)
+    with pytest.raises(RpcError):
+        asyncio.run(pull(fake, cli, "oid", len(payload), 64 * 1024))
+
+
+# ------------------------------------------------ segment map cache (a)
+def test_read_raw_reuses_segment_mapping():
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_store import SharedObjectStore
+
+    store = SharedObjectStore("maptest")
+    oid = ObjectID.from_random()
+    data = bytes(range(256)) * 16
+    try:
+        store.put_raw(oid, data)
+        assert store.read_raw(oid, len(data)) == data
+        assert oid in store._mapped              # cached after first read
+        seg = store._mapped[oid]
+        # Chunked sends: repeated slice reads reuse ONE mapping.
+        for off in range(0, len(data), 512):
+            assert store.read_raw_slice(oid, off, 512) == \
+                data[off:off + 512]
+        assert store._mapped[oid] is seg
+        store.delete(oid)
+        assert oid not in store._mapped          # delete drops the map
+    finally:
+        store.close()
+
+
+# --------------------------------------------------- device prefetch (4)
+def _host_batches(n, bs=4):
+    return [{"tokens": np.full((bs, 8), i, np.int32)} for i in range(n)]
+
+
+def test_iter_device_batches_values_and_order():
+    from ray_tpu import train as rt_train
+
+    got = list(rt_train.iter_device_batches(_host_batches(5), depth=2))
+    assert len(got) == 5
+    for i, b in enumerate(got):
+        arr = np.asarray(b["tokens"])            # device -> host
+        assert arr.dtype == np.int32 and (arr == i).all()
+
+
+def test_iter_device_batches_charges_data_stall():
+    import time
+
+    from ray_tpu import train as rt_train
+    from ray_tpu.util import goodput
+
+    def slow_source():
+        for b in _host_batches(3):
+            time.sleep(0.05)                     # starve the consumer
+            yield b
+
+    ledger = goodput.reset()
+    assert len(list(rt_train.iter_device_batches(slow_source(),
+                                                 depth=1))) == 3
+    stall = ledger.snapshot()["seconds"]["data_stall"]
+    assert stall > 0.05                          # waits were attributed
+
+
+def test_iter_device_batches_propagates_and_cleans_up():
+    from ray_tpu import train as rt_train
+
+    def bad_source():
+        yield _host_batches(1)[0]
+        raise RuntimeError("loader died")
+
+    it = rt_train.iter_device_batches(bad_source(), depth=2)
+    next(it)
+    with pytest.raises(RuntimeError, match="loader died"):
+        for _ in it:
+            pass
+    # Abandoning mid-stream joins the feeder thread.
+    it2 = rt_train.iter_device_batches(_host_batches(10), depth=1)
+    next(it2)
+    it2.close()
+    assert [t for t in threading.enumerate()
+            if t.name.startswith("rt-device-prefetch")
+            and t.is_alive()] == []
+
+
+def test_iter_device_batches_custom_transfer():
+    from ray_tpu import train as rt_train
+
+    seen = []
+
+    def xfer(b):
+        seen.append(True)
+        return {k: v + 1 for k, v in b.items()}
+
+    got = list(rt_train.iter_device_batches(_host_batches(3),
+                                            transfer=xfer))
+    assert len(seen) == 3
+    assert (np.asarray(got[0]["tokens"]) == 1).all()
