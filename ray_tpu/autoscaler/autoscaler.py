@@ -169,8 +169,13 @@ class StandardAutoscaler:
         return counts
 
     async def _scale_up(self, lm: Dict) -> List[str]:
-        demands: List[Dict[str, float]] = list(lm["pending_demands"])
+        # (priority, shape): plain lease demand carries no priority
+        # (0); pending gangs carry their job's.  Higher priority packs
+        # and launches first, so when the launch batch cap bites, the
+        # nodes that do come up serve the most important waiter.
+        prioritized: List = [(0, dict(d)) for d in lm["pending_demands"]]
         for pg in lm["pending_placement_groups"]:
+            pri = int(pg.get("priority", 0))
             # STRICT_PACK bundles must land on ONE node: fuse them so
             # bin-packing can't split what placement won't.
             if pg["strategy"] == "STRICT_PACK":
@@ -178,10 +183,10 @@ class StandardAutoscaler:
                 for b in pg["bundles"]:
                     for k, v in b.items():
                         fused[k] = fused.get(k, 0.0) + v
-                demands.append(fused)
+                prioritized.append((pri, fused))
             else:
-                demands.extend(pg["bundles"])
-        if not demands:
+                prioritized.extend((pri, b) for b in pg["bundles"])
+        if not prioritized:
             return []
 
         # Capacity that can still absorb demand: live nodes' available
@@ -202,8 +207,9 @@ class StandardAutoscaler:
 
         counts = self._counts_by_type()
         to_launch: List[NodeType] = []
-        for demand in sorted(demands,
-                             key=lambda d: -sum(d.values())):
+        for _pri, demand in sorted(
+                prioritized,
+                key=lambda pd: (-pd[0], -sum(pd[1].values()))):
             placed = False
             for cap in capacity:
                 if _fits(cap, demand):

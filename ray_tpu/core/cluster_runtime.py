@@ -265,8 +265,13 @@ class ClusterRuntime(BaseRuntime):
         job_id = _job_id
         self._registered_job_int: Optional[int] = None
         if job_id is None:
-            r = self.io.run(self._ctl.call("register_job",
-                                           {"driver": f"pid-{os.getpid()}"}))
+            r = self.io.run(self._ctl.call("register_job", {
+                "driver": f"pid-{os.getpid()}",
+                # Multi-tenant link: a submitted job's entrypoint
+                # driver carries its submission id so leases/PGs
+                # tagged with this internal job resolve to the tenant
+                # for quota enforcement and goodput attribution.
+                "tenant": os.environ.get("RT_JOB_ID", "")}))
             job_id = JobID.from_int(r["job_id"])
             self._registered_job_int = r["job_id"]
         super().__init__(config, job_id)

@@ -217,6 +217,22 @@ define_flag("restart_backoff_jitter", float, 0.2,
             "Fractional jitter on each restart delay (0.2 = +/-20%), "
             "decorrelating gang restarts across drivers after a "
             "fleet-wide preemption wave.")
+define_flag("job_preemption_enabled", bool, True,
+            "Let a high-priority gang that cannot place preempt a "
+            "strictly-lower-priority job's gang through the drain/"
+            "checkpoint-on-notice path (the victim restarts from its "
+            "notice checkpoint without burning max_failures).")
+define_flag("preempt_pending_s", float, 2.0,
+            "How long a high-priority gang must sit unplaceable before "
+            "the controller selects a preemption victim — a short "
+            "damper so capacity about to free naturally (a finishing "
+            "gang, a joining node) is not bought with a kill.")
+define_flag("starvation_warn_s", float, 60.0,
+            "Doctor threshold: a gang/lease request pending longer "
+            "than this yields a starved-job finding naming the job, "
+            "its priority, and the jobs holding the contested "
+            "resources (critical when the starved job outranks every "
+            "holder).")
 define_flag("straggler_threshold", float, 0.2,
             "Straggler detector: a rank whose step time exceeds the "
             "per-step median by this fraction, sustained over the "

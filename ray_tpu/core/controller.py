@@ -103,6 +103,22 @@ class Controller:
         self.event_waiters: List[asyncio.Event] = []
         self.jobs: Dict[int, Dict] = {}
         self.job_counter = 1
+        # Multi-tenant job plane: per-submitted-job metadata keyed by
+        # the STRING submission id (the `job-...` id the supervisor
+        # registers) — priority, optional resource quota, submit time.
+        # Distinct from self.jobs, which tracks internal driver
+        # registrations; the two link through the driver's RT_JOB_ID
+        # (register_job's "tenant" field).
+        self.job_plane: Dict[str, Dict] = {}
+        # Active preemption notices: job_id -> {deadline, reason, by}.
+        # The victim's trainer polls job_preemption_state on its drain
+        # cadence; at the deadline _job_preemption_loop enforces by
+        # evicting the job's placement groups.
+        self.preempting: Dict[str, Dict] = {}
+        # Agent-reported plain-lease usage per node: node_hex ->
+        # {internal_job_hex: {resource: amount}} (PG-bound leases are
+        # excluded — bundle reservations are counted controller-side).
+        self._job_usage_by_node: Dict[str, Dict[str, Dict]] = {}
         # Task-event sink (ref: gcs_task_manager.h:86 GcsTaskManager):
         # bounded per-task records for the state API + Chrome-trace
         # timeline export; oldest finished records are dropped first.
@@ -160,6 +176,8 @@ class Controller:
             "report_spans", "list_spans", "report_profile",
             "explain_task", "collective_entries",
             "report_autoscaler_decision", "doctor_feed",
+            "job_register", "jobs_overview", "preempt_job",
+            "job_preemption_state",
         ]:
             self.server.register(name, getattr(self, name))
 
@@ -243,7 +261,18 @@ class Controller:
                 node.drain_deadline = p.get("drain_deadline", 0.0)
             node.drain_reason = p.get("drain_reason", "")
             node.drain_replace = p.get("drain_replace", True)
-        return {"ok": True}
+        if "job_usage" in p:
+            self._job_usage_by_node[node.node_id.hex()] = \
+                p["job_usage"] or {}
+        out = {"ok": True}
+        view = self._job_quota_view()
+        if view:
+            # Quota/priority view for lease-grant-time enforcement at
+            # the agent: {internal_job_hex: {job, priority, quota,
+            # used}}.  Eventually consistent within a heartbeat period
+            # — the agent overlays its own since-last-report grants.
+            out["jobs"] = view
+        return out
 
     async def get_load_metrics(self, _p):
         """Autoscaler input: per-node utilization + unsatisfied demand
@@ -276,7 +305,10 @@ class Controller:
             for entry in self._placement._groups.values():
                 if entry.state in ("PENDING", "RESCHEDULING"):
                     pg_demands.append({"bundles": list(entry.bundles),
-                                       "strategy": entry.strategy})
+                                       "strategy": entry.strategy,
+                                       "priority": getattr(entry,
+                                                           "priority", 0),
+                                       "job": getattr(entry, "job", "")})
         return {"nodes": nodes, "pending_demands": demands,
                 "pending_placement_groups": pg_demands}
 
@@ -412,6 +444,7 @@ class Controller:
 
     async def _mark_node_dead(self, node: NodeEntry, reason: str) -> None:
         node.alive = False
+        self._job_usage_by_node.pop(node.node_id.hex(), None)
         logger.warning("node %s dead: %s", node.node_id.hex()[:8], reason)
         self._publish("node", {"node_id": node.node_id, "state": "DEAD"})
         # Fail or restart every actor that lived there.
@@ -1071,6 +1104,222 @@ class Controller:
         return {"jobs": [dict(j, job_id=jid)
                          for jid, j in self.jobs.items()]}
 
+    # ------------------------------------------------- multi-tenant jobs
+    async def job_register(self, p):
+        """Register a submitted job's multi-tenant metadata (priority,
+        optional quota) — called by the job supervisor before the
+        entrypoint spawns, so admission/quota decisions never race the
+        job's first lease request."""
+        job_id = p["job_id"]
+        quota = p.get("quota") or None
+        if quota is not None:
+            quota = {str(k): float(v) for k, v in quota.items()}
+        self.job_plane[job_id] = {
+            "job_id": job_id,
+            "priority": int(p.get("priority") or 0),
+            "quota": quota,
+            "entrypoint": p.get("entrypoint", ""),
+            "submitted": p.get("ts") or time.time(),
+        }
+        self._publish("job", {"job_id": job_id, "state": "REGISTERED",
+                              "priority": self.job_plane[job_id]
+                              ["priority"]})
+        return {"ok": True}
+
+    def _tenant_of_hex(self, job_hex: str) -> str:
+        """Map an internal driver job hex to its tenant job id."""
+        cache = getattr(self, "_tenant_cache", None)
+        if cache is None:
+            cache = self._tenant_cache = {}
+        hit = cache.get(job_hex)
+        if hit is not None:
+            return hit
+        for jid, rec in self.jobs.items():
+            h = JobID.from_int(jid).hex()
+            cache[h] = rec.get("tenant", "")
+        return cache.get(job_hex, "")
+
+    def _job_usage(self, job_id: str,
+                   exclude_pg=None) -> Dict[str, float]:
+        """Cluster-wide resource usage attributed to one tenant job:
+        committed placement-group bundles (controller's own books) +
+        agent-reported plain leases (heartbeat overlay)."""
+        used: Dict[str, float] = {}
+        if self._placement is not None:
+            for entry in self._placement._groups.values():
+                if getattr(entry, "job", "") != job_id or \
+                        entry.state != "CREATED" or \
+                        entry.pg_id == exclude_pg:
+                    continue
+                for b in entry.bundles:
+                    for k, v in b.items():
+                        used[k] = used.get(k, 0.0) + v
+        for per_job in self._job_usage_by_node.values():
+            for job_hex, res in per_job.items():
+                if self._tenant_of_hex(job_hex) != job_id:
+                    continue
+                for k, v in res.items():
+                    used[k] = used.get(k, 0.0) + v
+        return used
+
+    def _job_is_terminal(self, job_id: str) -> bool:
+        import json as _json
+
+        raw = self.kv.get(f"job/{job_id}/status")
+        if not raw:
+            return False
+        try:
+            return _json.loads(raw).get("status") in (
+                "SUCCEEDED", "FAILED", "STOPPED")
+        except (ValueError, TypeError):
+            return False
+
+    def _job_quota_view(self) -> Dict[str, Dict]:
+        """The per-internal-job view shipped to agents in heartbeat
+        replies: only jobs whose tenant registered a quota or a
+        non-zero priority (keeps the common single-tenant heartbeat
+        payload empty).  Terminal tenants and dead drivers are
+        skipped — they can request nothing, and without the filter
+        the view (computed per heartbeat, shipped to every agent)
+        would grow with job history forever."""
+        if not self.job_plane:
+            return {}
+        interesting = {j: rec for j, rec in self.job_plane.items()
+                       if (rec.get("quota") or rec.get("priority"))
+                       and not self._job_is_terminal(j)}
+        if not interesting:
+            return {}
+        out: Dict[str, Dict] = {}
+        usage_cache: Dict[str, Dict[str, float]] = {}
+        for jid, rec in self.jobs.items():
+            if not rec.get("alive", True):
+                continue  # a dead driver can't request leases
+            tenant = rec.get("tenant", "")
+            plane = interesting.get(tenant)
+            if plane is None:
+                continue
+            if tenant not in usage_cache:
+                usage_cache[tenant] = self._job_usage(tenant)
+            out[JobID.from_int(jid).hex()] = {
+                "job": tenant,
+                "priority": plane["priority"],
+                "quota": plane.get("quota"),
+                "used": usage_cache[tenant],
+            }
+        return out
+
+    async def jobs_overview(self, p):
+        """`rt jobs` / /api/jobs: every submitted job with priority,
+        quota, live resource usage, state, and submission time.
+        ``job_id`` prefix-filters (the `rt explain` convention)."""
+        prefix = (p or {}).get("job_id") or ""
+        import json as _json
+
+        ids = set(self.job_plane)
+        for key in self.kv:
+            if key.startswith("job/") and key.endswith("/status"):
+                ids.add(key.split("/", 2)[1])
+        rows = []
+        for job_id in sorted(ids):
+            if prefix and not job_id.startswith(prefix):
+                continue
+            plane = self.job_plane.get(job_id, {})
+            status: Dict[str, Any] = {}
+            raw = self.kv.get(f"job/{job_id}/status")
+            if raw:
+                try:
+                    status = _json.loads(raw)
+                except (ValueError, TypeError):
+                    status = {}
+            row = {
+                "job_id": job_id,
+                "priority": plane.get("priority", 0),
+                "quota": plane.get("quota"),
+                "usage": self._job_usage(job_id),
+                "state": status.get("status", "?"),
+                "message": status.get("message", ""),
+                "entrypoint": status.get("entrypoint")
+                or plane.get("entrypoint", ""),
+                "submitted": plane.get("submitted")
+                or status.get("ts", 0.0),
+            }
+            pre = self.preempting.get(job_id)
+            if pre is not None:
+                row["preempting"] = {
+                    "reason": pre.get("reason", ""),
+                    "by": pre.get("by", ""),
+                    "remaining_s": max(pre["deadline"] - time.time(),
+                                       0.0)}
+            rows.append(row)
+        return {"jobs": rows}
+
+    async def preempt_job(self, p):
+        """Mark a job for preemption: the victim's trainer observes it
+        on its drain-poll cadence (checkpoint-on-notice inside the
+        grace window); at the deadline the enforcement loop evicts the
+        job's placement groups, so the gang dies as an ANNOUNCED
+        failure and restarts from the notice checkpoint."""
+        job_id = p["job_id"]
+        if job_id in self.preempting:
+            return {"ok": True, "already": True,
+                    "deadline": self.preempting[job_id]["deadline"]}
+        grace = p.get("grace_s")
+        if grace is None:  # explicit 0 means evict immediately
+            grace = self.config.preemption_grace_s
+        rec = {"job_id": job_id, "reason": p.get("reason", "preempted"),
+               "by": p.get("by", ""), "ts": time.time(),
+               "deadline": time.time() + max(float(grace), 0.0)}
+        self.preempting[job_id] = rec
+        logger.warning("job %s preempting (%s): grace %.1fs",
+                       job_id, rec["reason"], grace)
+        self._publish("job", {"job_id": job_id, "state": "PREEMPTING",
+                              "reason": rec["reason"],
+                              "deadline": rec["deadline"]})
+        return {"ok": True, "deadline": rec["deadline"]}
+
+    async def job_preemption_state(self, p):
+        """Polled by the victim's trainer driver (its drain-poll
+        cadence): the deadline crosses hosts as REMAINING seconds, the
+        same clock discipline as node drains."""
+        rec = self.preempting.get(p.get("job_id") or "")
+        if rec is None:
+            return {"preempting": False}
+        return {"preempting": True,
+                "reason": rec.get("reason", ""),
+                "by": rec.get("by", ""),
+                "remaining_s": max(rec["deadline"] - time.time(), 0.0)}
+
+    async def _job_preemption_loop(self) -> None:
+        """Enforce preemption deadlines: once the grace expires, evict
+        the victim's placement groups (killing the gang workers), so
+        capacity frees for the admission loop's next pass.  The notice
+        is cleared BEFORE enforcement — the victim's next attempt must
+        not see a stale interrupt and checkpoint-on-notice forever."""
+        while not self._shutdown.is_set():
+            await asyncio.sleep(0.25)
+            now = time.time()
+            for job_id, rec in list(self.preempting.items()):
+                if now < rec["deadline"]:
+                    continue
+                del self.preempting[job_id]
+                self._tenant_cache = {}
+                logger.warning("job %s preemption grace expired; "
+                               "evicting its gangs", job_id)
+                self._publish("job", {"job_id": job_id,
+                                      "state": "PREEMPTED",
+                                      "reason": rec.get("reason", "")})
+                self.autoscaler_decisions.append({
+                    "ts": now, "demands": 0, "launched": [],
+                    "terminated": [], "unsatisfied": [],
+                    "preempted": [f"job:{job_id}"]})
+                if self._placement is not None:
+                    try:
+                        await self._placement.preempt_job_groups(
+                            job_id, reason=rec.get("reason", ""))
+                    except Exception:
+                        logger.exception("preemption enforcement for "
+                                         "job %s failed", job_id)
+
     # --------------------------------------------------------- metrics
     async def report_metrics(self, p):
         now = time.time()
@@ -1248,7 +1497,13 @@ class Controller:
         jid = self.job_counter
         self.job_counter += 1
         self.jobs[jid] = {"start": time.time(), "driver": p.get("driver", ""),
-                          "alive": True}
+                          "alive": True,
+                          # Link to the multi-tenant job plane: the
+                          # submitted job's entrypoint driver carries
+                          # its RT_JOB_ID here, so leases/PGs tagged
+                          # with the internal job hex resolve to the
+                          # tenant for quota/priority/attribution.
+                          "tenant": p.get("tenant", "")}
         self._mark_dirty()
         return {"job_id": jid}
 
@@ -1319,6 +1574,7 @@ class Controller:
             spawn_task(self._persist_loop())
         await self.server.start(port)
         spawn_task(self._health_loop())
+        spawn_task(self._job_preemption_loop())
         if driver_pid:
             spawn_task(self._watch_driver(driver_pid))
         return self.server.port
@@ -1340,11 +1596,15 @@ class Controller:
                 pgs.append({
                     "pg_id": e.pg_id, "bundles": e.bundles,
                     "strategy": e.strategy, "state": e.state,
-                    "name": e.name, "placement": dict(e.placement)})
+                    "name": e.name, "placement": dict(e.placement),
+                    "priority": e.priority, "job": e.job,
+                    "create_time": e.create_time})
         return {
             "kv": self.kv, "kv_list_counts": self.kv_list_counts,
             "actors": self.actors, "named_actors": self.named_actors,
             "jobs": self.jobs, "job_counter": self.job_counter,
+            "job_plane": self.job_plane,
+            "preempting": self.preempting,
             "task_records": self.task_records,
             "task_events_dropped": self.task_events_dropped,
             "event_seq": self.event_seq,
@@ -1394,6 +1654,8 @@ class Controller:
         self.named_actors = state["named_actors"]
         self.jobs = state["jobs"]
         self.job_counter = state["job_counter"]
+        self.job_plane = state.get("job_plane", {})
+        self.preempting = state.get("preempting", {})
         self.task_records = state["task_records"]
         self.task_events_dropped = state["task_events_dropped"]
         # Event history is gone: continue the sequence and mark all of
@@ -1407,9 +1669,16 @@ class Controller:
         for rec in state["placement_groups"]:
             entry = PGEntry(pg_id=rec["pg_id"], bundles=rec["bundles"],
                             strategy=rec["strategy"], state=rec["state"],
-                            name=rec["name"])
+                            name=rec["name"],
+                            priority=rec.get("priority", 0),
+                            job=rec.get("job", ""))
+            if rec.get("create_time"):
+                entry.create_time = rec["create_time"]
             entry.placement = rec["placement"]
             self._placement._groups[rec["pg_id"]] = entry
+        # Restored PENDING/RESCHEDULING groups need the admission loop
+        # running again (the pre-restart loop died with the process).
+        self._placement.kick()
         logger.info("restored controller state: %d actors, %d kv keys, "
                     "%d jobs, %d PGs", len(self.actors), len(self.kv),
                     len(self.jobs), len(state["placement_groups"]))

@@ -76,6 +76,11 @@ class Lease:
     # worker and its resources forever.
     owner_tag: str = ""
     granted_ts: float = 0.0
+    # Internal job hex of the submitting driver — resolves to the
+    # multi-tenant submitted-job id through the controller's
+    # heartbeat-distributed job view (quota enforcement + per-job
+    # attribution in the lease ledger).
+    job_id: str = ""
 
 
 @dataclass
@@ -169,6 +174,13 @@ class NodeAgent:
         self._owner_lease_depths: Dict[int, tuple] = {}
         self._owner_conn_lost_ts: Dict[str, float] = {}
         self._owner_disc_since: Dict[int, float] = {}
+        # Multi-tenant quota view from heartbeat replies:
+        # {internal_job_hex: {job, priority, quota, used}} — the
+        # lease-grant path refuses (queues) grants that would run a
+        # job over quota.  Last-reported local usage lets the grant
+        # check overlay its own since-last-heartbeat deltas.
+        self._job_view: Dict[str, Dict] = {}
+        self._job_usage_reported: Dict[str, Dict[str, float]] = {}
         self._shutdown = asyncio.Event()
         self._spawned_procs: List[subprocess.Popen] = []
         for name in [
@@ -184,6 +196,7 @@ class NodeAgent:
             "object_exists", "objects_exist", "store_stats",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "restart_actor", "kill_worker", "report_actor_failure",
+            "preempt_pg_leases",
             "drain", "shutdown", "ping", "node_info", "list_workers",
             "list_worker_logs", "read_worker_log", "profile_worker",
             "stack_worker",
@@ -340,6 +353,11 @@ class NodeAgent:
                 # via report_backlog; ref: ReportWorkerBacklog in
                 # normal_task_submitter.h).
                 demands = self._demand_vector()
+                # Snapshot ONCE and remember exactly what was sent:
+                # recomputing after the RPC await would fold leases
+                # granted mid-await into the "already reported" side
+                # of the quota overlay and hide them from the check.
+                job_usage = self._job_usage_local()
                 if self.pending:
                     # Self-healing dispatch tick: a request requeued
                     # after a failed worker acquire has no event left
@@ -367,7 +385,13 @@ class NodeAgent:
                     "draining": self._draining,
                     "drain_remaining_s": self._drain_remaining(),
                     "drain_reason": self._drain_reason,
-                    "drain_replace": self._drain_replace})
+                    "drain_replace": self._drain_replace,
+                    # Multi-tenant accounting: plain-lease usage per
+                    # internal job (PG-bound leases excluded — their
+                    # bundles are counted controller-side).
+                    "job_usage": job_usage})
+                self._job_usage_reported = job_usage
+                self._job_view = r.get("jobs") or {}
                 now = time.time()
                 if now - last_metrics >= \
                         self.config.metrics_report_period_s:
@@ -742,6 +766,7 @@ class NodeAgent:
 
     def _node_metrics_snapshot(self) -> List[Dict]:
         n_obj, used, cap = self.directory.stats()
+        spill = self.directory.spill_stats()
         states: Dict[str, int] = {}
         for w in self.workers.values():
             states[w.state] = states.get(w.state, 0) + 1
@@ -775,6 +800,23 @@ class NodeAgent:
              "description": "Schedulable resources available.",
              "series": [{"tags": {"resource": k}, "value": v}
                         for k, v in self.available.amounts.items()]},
+            # Object-plane spill counters: these previously died
+            # in-process (visible only via the store_stats RPC nobody
+            # polls); as metrics they ride the heartbeat into
+            # `rt telemetry` / Prometheus.
+            {"name": "rt_object_spilled_bytes", "kind": "gauge",
+             "description": "Bytes currently spilled to disk by the "
+                            "local object store.",
+             "series": [{"tags": {},
+                         "value": spill["spilled_bytes"]}]},
+            {"name": "rt_object_spill_total", "kind": "counter",
+             "description": "Objects spilled to disk (cumulative).",
+             "series": [{"tags": {}, "value": spill["spill_count"]}]},
+            {"name": "rt_object_restore_total", "kind": "counter",
+             "description": "Spilled objects restored into shm "
+                            "(cumulative).",
+             "series": [{"tags": {},
+                         "value": spill["restore_count"]}]},
         ]
 
     def _max_workers(self) -> int:
@@ -892,12 +934,49 @@ class NodeAgent:
                 return b
         return None
 
+    def _job_usage_local(self) -> Dict[str, Dict[str, float]]:
+        """Per-internal-job resource usage of this node's plain leases
+        (PG-bound leases excluded: their bundles are accounted at the
+        controller, and counting both would double-charge quotas)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for lease in self.leases.values():
+            if lease.pg_id is not None or not lease.job_id:
+                continue
+            acc = out.setdefault(lease.job_id, {})
+            for k, v in lease.resources.amounts.items():
+                acc[k] = acc.get(k, 0.0) + v
+        return out
+
+    def _quota_refuses(self, payload) -> bool:
+        """Lease-grant-time quota enforcement: True when granting this
+        plain lease would run its job over quota — the request stays
+        QUEUED and grants as soon as the job's usage drops.  Usage =
+        the controller's cluster-wide view minus what this node
+        reported into it, plus this node's live books (so back-to-back
+        local grants inside one heartbeat period can't overshoot)."""
+        if payload.get("pg_id") is not None:
+            return False  # bundle capacity was quota-charged at admission
+        job_hex = payload.get("job_id") or ""
+        view = self._job_view.get(job_hex)
+        if view is None or not view.get("quota"):
+            return False
+        from ..util import multitenant
+
+        used = multitenant.overlay_usage(
+            view.get("used") or {},
+            self._job_usage_reported.get(job_hex, {}),
+            self._job_usage_local().get(job_hex, {}))
+        return multitenant.quota_exceeded(view["quota"], used,
+                                          dict(payload["resources"]))
+
     async def _try_grant(self, payload) -> Optional[Dict]:
         # A draining node grants NOTHING — not even queued requests
         # that predate the drain (they are redirected by _begin_drain)
         # or actor restarts (the controller retries on a live node).
         if self._draining:
             return None
+        if self._quota_refuses(payload):
+            return None  # over quota: stay queued until usage drops
         # Reserve resources synchronously (no awaits) so concurrent grant
         # attempts can't double-spend, then await a worker and refund on
         # failure.
@@ -956,7 +1035,8 @@ class NodeAgent:
             lease_id=next(self._lease_counter), resources=demand, worker=w,
             chip_ids=chip_ids, pg_id=payload.get("pg_id"),
             bundle_index=payload.get("bundle_index", -1),
-            owner_tag=owner_tag, granted_ts=time.time())
+            owner_tag=owner_tag, granted_ts=time.time(),
+            job_id=payload.get("job_id") or "")
         w.state = "actor" if payload.get("is_actor") else "leased"
         w.lease_id = lease.lease_id
         if payload.get("job_id"):
@@ -1392,6 +1472,11 @@ class NodeAgent:
                 "bundle_index": lease.bundle_index,
                 "age_s": (now - lease.granted_ts
                           if lease.granted_ts else 0.0),
+                # Per-job attribution: the submitted-job id when the
+                # heartbeat view can resolve it, else the internal
+                # driver job hex.
+                "job": (self._job_view.get(lease.job_id, {})
+                        .get("job") or lease.job_id[:12]),
             }
             dep = depths.get(lease.lease_id)
             if dep is not None:
@@ -1852,6 +1937,35 @@ class NodeAgent:
             self._clamp_available()
             self._kick_scheduler()
         return {"ok": True}
+
+    async def preempt_pg_leases(self, p):
+        """Job-preemption enforcement (controller-driven): SIGKILL the
+        workers holding leases under this placement group's bundles.
+        The deaths flow through the normal reap path — actor_died with
+        the worker gone — so the owning trainer sees its gang fail
+        AFTER the preemption notice it has been polling, classifies
+        the loss as announced, and restarts from the checkpoint-on-
+        notice.  Bundle reservations are returned separately by the
+        controller's remove_placement_group pass."""
+        pg_id = p["pg_id"]
+        killed = []
+        for lease in list(self.leases.values()):
+            if lease.pg_id != pg_id:
+                continue
+            w = lease.worker
+            try:
+                if w.proc is not None:
+                    w.proc.kill()
+                else:
+                    os.kill(w.pid, signal.SIGKILL)
+                killed.append(w.pid)
+            except (ProcessLookupError, PermissionError):
+                pass
+        if killed:
+            logger.warning("preempted %d worker(s) of pg %s (%s)",
+                           len(killed), pg_id.hex()[:12],
+                           p.get("reason", ""))
+        return {"ok": True, "killed": killed}
 
     # ------------------------------------------------------ actor lifecycle
     async def restart_actor(self, p):

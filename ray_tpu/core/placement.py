@@ -7,6 +7,21 @@ python/ray/util/placement_group.py:145).  TPU-era framing: a bundle is
 typically one TPU host's chips; STRICT_SPREAD maps slices across hosts so a
 gang-scheduled worker group aligns 1:1 with the jax.distributed world.
 
+Multi-tenant admission: groups carry a ``priority`` (int, default 0) and
+an owning ``job`` (the submitted job id).  ONE serialized admission loop
+tries pending groups in (priority desc, FIFO) order — a group either
+fully admits or fully waits, and two gangs can no longer interleave
+partial prepare reservations across nodes (the cross-job deadlock the
+per-group schedulers allowed).  While a higher-priority group is blocked
+on capacity, strictly-lower-priority groups wait behind it, so freed
+capacity always goes to the highest-priority waiter; equal-priority
+groups may still backfill smaller holes.  A group blocked past
+``preempt_pending_s`` selects victim jobs (strictly lower priority,
+newest first) and preempts them through the controller's job-preemption
+plane — the drain/checkpoint-on-notice path, not a silent kill.
+Per-job quotas gate admission: a group that would run its job over
+quota waits (reason ``over_quota``) without blocking other jobs.
+
 Controller-side manager (this file) + client API (placement_api.py).
 """
 
@@ -18,6 +33,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from ..util import multitenant
 from .ids import NodeID, PlacementGroupID
 from .rpc import RpcError, spawn_task
 
@@ -38,6 +54,9 @@ class PGEntry:
     strategy: str
     state: str = PENDING
     name: str = ""
+    # Multi-tenant admission: priority + owning submitted-job id.
+    priority: int = 0
+    job: str = ""
     # bundle index -> node id (filled at commit)
     placement: Dict[int, NodeID] = field(default_factory=dict)
     create_time: float = field(default_factory=time.time)
@@ -46,6 +65,13 @@ class PGEntry:
     # this group will need rescheduling when it dies (surfaced in
     # get()/list so operators see which gangs a drain will move).
     migrate_pending: bool = False
+    # Admission bookkeeping: when the group first failed to place and
+    # why it is still waiting (no_capacity / over_quota /
+    # behind_higher_priority) — the starved-jobs doctor check reads
+    # these.
+    pending_since: float = 0.0
+    pending_reason: str = ""
+    preempt_fired_ts: float = 0.0
 
 
 def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
@@ -57,20 +83,203 @@ def _sub(avail: Dict[str, float], demand: Dict[str, float]) -> None:
         avail[k] = avail.get(k, 0.0) - v
 
 
+def _add(avail: Dict[str, float], extra: Dict[str, float]) -> None:
+    for k, v in extra.items():
+        avail[k] = avail.get(k, 0.0) + v
+
+
 class PlacementGroupManager:
     def __init__(self, controller):
         self._ctl = controller
         self._groups: Dict[PlacementGroupID, PGEntry] = {}
+        self._wakeup = asyncio.Event()
+        self._admission_task = None
+
+    # -------------------------------------------------------- admission
+    def kick(self) -> None:
+        """Wake (or start) the serialized admission loop."""
+        self._wakeup.set()
+        t = self._admission_task
+        if t is None or t.done():
+            self._admission_task = spawn_task(self._admission_loop())
+
+    async def _admission_loop(self) -> None:
+        """ONE loop admits every pending group, in (priority desc,
+        FIFO) order.  Serialization is the anti-deadlock property: at
+        most one group is in its prepare/commit window at a time, so
+        partial reservations from two racing gangs can never wedge
+        each other across nodes."""
+        delay = 0.05
+        while True:
+            self._wakeup.clear()
+            pending = sorted(
+                (e for e in self._groups.values()
+                 if e.state in (PENDING, RESCHEDULING)),
+                key=lambda e: multitenant.admission_key(
+                    e.priority, e.create_time))
+            if not pending:
+                if self._wakeup.is_set():
+                    continue  # a kick landed after the scan
+                return
+            progressed = False
+            blocked_priority: Optional[int] = None
+            now = time.time()
+            for entry in pending:
+                if entry.state not in (PENDING, RESCHEDULING):
+                    continue  # removed/admitted mid-pass
+                if blocked_priority is not None and \
+                        entry.priority < blocked_priority:
+                    # Head-of-line by priority: freed capacity must
+                    # reach the blocked higher-priority gang, not be
+                    # backfilled by the very job it preempted.
+                    if not entry.pending_since:
+                        entry.pending_since = now
+                    entry.pending_reason = "behind_higher_priority"
+                    continue
+                if self._over_quota(entry):
+                    if not entry.pending_since:
+                        entry.pending_since = now
+                    entry.pending_reason = "over_quota"
+                    continue  # blocked by its own cap; gates nobody
+                if await self._try_commit(entry):
+                    progressed = True
+                else:
+                    if not entry.pending_since:
+                        entry.pending_since = now
+                    entry.pending_reason = "no_capacity"
+                    if blocked_priority is None:
+                        blocked_priority = entry.priority
+                    await self._maybe_preempt(entry, now)
+            if progressed:
+                delay = 0.05
+                continue
+            try:
+                await asyncio.wait_for(self._wakeup.wait(), delay)
+                delay = 0.05
+            except asyncio.TimeoutError:
+                delay = min(delay * 1.5, 2.0)
+
+    def _over_quota(self, entry: PGEntry) -> bool:
+        """Would admitting this group run its job over quota?"""
+        if not entry.job:
+            return False
+        plane = self._ctl.job_plane.get(entry.job)
+        quota = plane and plane.get("quota")
+        if not quota:
+            return False
+        need: Dict[str, float] = {}
+        for b in entry.bundles:
+            _add(need, b)
+        used = self._ctl._job_usage(entry.job, exclude_pg=entry.pg_id)
+        return multitenant.quota_exceeded(quota, used, need)
+
+    async def _maybe_preempt(self, entry: PGEntry, now: float) -> None:
+        """A gang blocked on capacity past the damper selects victim
+        jobs — strictly lower priority, newest first — whose eviction
+        makes its plan feasible, and drives them into the controller's
+        job-preemption plane (notice -> checkpoint-on-notice ->
+        announced restart)."""
+        cfg = self._ctl.config
+        if not cfg.job_preemption_enabled:
+            return
+        start = entry.pending_since or entry.create_time
+        if now - start < cfg.preempt_pending_s:
+            return
+        if entry.preempt_fired_ts and \
+                now - entry.preempt_fired_ts < \
+                cfg.preemption_grace_s + 5.0:
+            return  # a preemption we triggered is still in flight
+        candidates = self._victim_candidates(entry)
+        if not candidates:
+            return
+        victims = multitenant.select_victims(
+            candidates,
+            feasible_with=lambda credits:
+                self._plan(entry, extra=credits) is not None,
+            requester_priority=entry.priority)
+        if not victims:
+            return
+        entry.preempt_fired_ts = now
+        who = entry.job or entry.pg_id.hex()[:12]
+        for job in victims:
+            logger.warning("gang %s (job %s, priority %d) preempts "
+                           "job %s", entry.pg_id.hex()[:12], who,
+                           entry.priority, job)
+            await self._ctl.preempt_job({
+                "job_id": job,
+                "by": entry.job,
+                "reason": f"preempted by job {who!r} "
+                          f"(priority {entry.priority})"})
+
+    def _victim_candidates(self, entry: PGEntry) -> List[Dict]:
+        """Lower-priority jobs holding committed gangs, with the
+        per-node credits their eviction would return.  Only job-tagged
+        groups are preemptible — anonymous infrastructure groups are
+        never victims."""
+        alive = {n.node_id for n in self._ctl.nodes.values()
+                 if n.alive and not getattr(n, "draining", False)}
+        by_job: Dict[str, Dict] = {}
+        for e in self._groups.values():
+            if e.state != CREATED or not e.job or e.job == entry.job \
+                    or e.job in self._ctl.preempting:
+                continue
+            plane = self._ctl.job_plane.get(e.job, {})
+            cand = by_job.setdefault(e.job, {
+                "job": e.job,
+                "priority": plane.get("priority", e.priority),
+                "submit_ts": plane.get("submitted", e.create_time),
+                "credits": {}})
+            cand["submit_ts"] = min(cand["submit_ts"], e.create_time) \
+                if not plane.get("submitted") else cand["submit_ts"]
+            for idx, nid in e.placement.items():
+                if nid not in alive:
+                    continue  # a dead node's capacity is no credit
+                multitenant.merge_credits(
+                    cand["credits"], {nid: dict(e.bundles[idx])})
+        return list(by_job.values())
+
+    async def preempt_job_groups(self, job_id: str,
+                                 reason: str = "") -> int:
+        """Enforcement teeth: kill the gang workers leased under the
+        job's bundles (their deaths surface as the announced failure
+        the trainer classifies via the preemption notice), then return
+        the bundles so the admission loop's next pass can place the
+        preemptor.  Returns the number of groups evicted."""
+        evicted = 0
+        for entry in [e for e in self._groups.values()
+                      if e.job == job_id and e.state != REMOVED]:
+            for nid in set(entry.placement.values()):
+                cli = await self._ctl._agent(nid)
+                if cli is None:
+                    continue
+                try:
+                    await cli.call("preempt_pg_leases", {
+                        "pg_id": entry.pg_id, "reason": reason})
+                except RpcError:
+                    pass  # node already dying takes its workers along
+            await self.remove({"pg_id": entry.pg_id})
+            evicted += 1
+        if evicted:
+            self.kick()
+        return evicted
 
     # ------------------------------------------------------------- placement
-    def _plan(self, entry: PGEntry) -> Optional[Dict[int, NodeID]]:
+    def _plan(self, entry: PGEntry,
+              extra: Optional[Dict[Any, Dict[str, float]]] = None
+              ) -> Optional[Dict[int, NodeID]]:
         """Bin-pack bundles onto alive nodes per strategy (ref:
-        BundleSchedulingPolicy in src/ray/raylet/scheduling/policy/)."""
+        BundleSchedulingPolicy in src/ray/raylet/scheduling/policy/).
+        ``extra`` credits hypothetical per-node availability — the
+        victim-selection simulation asks "would this plan work if that
+        job's bundles came back?"."""
         nodes = [n for n in self._ctl.nodes.values()
                  if n.alive and not getattr(n, "draining", False)]
         if not nodes:
             return None
         avail = {n.node_id: dict(n.resources_available) for n in nodes}
+        for nid, credit in (extra or {}).items():
+            if nid in avail:
+                _add(avail[nid], credit)
         plan: Dict[int, NodeID] = {}
         strategy = entry.strategy
         order = sorted(range(len(entry.bundles)),
@@ -144,6 +353,11 @@ class PlacementGroupManager:
                 ok = False
                 break
             prepared.append(idx)
+        # The prepare RPCs awaited: a remove()/preemption may have
+        # landed mid-window — committing now would resurrect a dead
+        # group with reserved-but-unreleasable bundles.
+        if entry.state == REMOVED:
+            ok = False
         if not ok:
             for idx in prepared:
                 cli = await self._ctl._agent(plan[idx])
@@ -165,6 +379,9 @@ class PlacementGroupManager:
                     pass
         entry.placement = plan
         entry.state = CREATED
+        entry.pending_since = 0.0
+        entry.pending_reason = ""
+        entry.preempt_fired_ts = 0.0
         for ev in entry.waiters:
             ev.set()
         entry.waiters.clear()
@@ -172,23 +389,17 @@ class PlacementGroupManager:
                            {"pg_id": entry.pg_id, "state": CREATED})
         return True
 
-    async def _schedule_loop(self, entry: PGEntry) -> None:
-        delay = 0.05
-        while entry.state in (PENDING, RESCHEDULING):
-            if await self._try_commit(entry):
-                return
-            await asyncio.sleep(delay)
-            delay = min(delay * 1.5, 2.0)
-
     # ----------------------------------------------------------------- RPCs
     async def create(self, p):
         strategy = p.get("strategy", "PACK")
         if strategy not in STRATEGIES:
             return {"ok": False, "error": f"unknown strategy {strategy!r}"}
         entry = PGEntry(pg_id=p["pg_id"], bundles=p["bundles"],
-                        strategy=strategy, name=p.get("name", ""))
+                        strategy=strategy, name=p.get("name", ""),
+                        priority=int(p.get("priority") or 0),
+                        job=p.get("job") or "")
         self._groups[entry.pg_id] = entry
-        spawn_task(self._schedule_loop(entry))
+        self.kick()
         return {"ok": True}
 
     async def remove(self, p):
@@ -209,6 +420,8 @@ class PlacementGroupManager:
             ev.set()
         self._ctl._publish("placement_group",
                            {"pg_id": entry.pg_id, "state": REMOVED})
+        # Returned bundles are capacity for whoever is next in line.
+        self.kick()
         return {"ok": True}
 
     def get(self, p):
@@ -224,6 +437,10 @@ class PlacementGroupManager:
         return {"pg_id": entry.pg_id, "state": entry.state,
                 "bundles": entry.bundles, "strategy": entry.strategy,
                 "placement": placement, "name": entry.name,
+                "priority": entry.priority, "job": entry.job,
+                "create_time": entry.create_time,
+                "pending_since": entry.pending_since,
+                "pending_reason": entry.pending_reason,
                 "migrate_pending": entry.migrate_pending}
 
     def list_all(self, _p):
@@ -261,7 +478,9 @@ class PlacementGroupManager:
                             pass
                 entry.placement = {}
                 entry.migrate_pending = False  # migration underway
+                entry.pending_since = 0.0
+                entry.pending_reason = ""
                 self._ctl._publish("placement_group",
                                    {"pg_id": entry.pg_id,
                                     "state": RESCHEDULING})
-                spawn_task(self._schedule_loop(entry))
+        self.kick()

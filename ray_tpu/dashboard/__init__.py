@@ -59,7 +59,11 @@ async function refresh() {
     "<h2>Recent tasks</h2>" + table(tasks, ["name", "state", "kind",
                                             "node_id", "worker_pid",
                                             "error"]) +
-    "<h2>Jobs</h2>" + table(jobs, ["job_id", "driver", "alive"]) +
+    "<h2>Jobs</h2>" + table(jobs.jobs || [], ["job_id", "priority",
+                                              "state", "quota", "usage",
+                                              "entrypoint"]) +
+    "<h2>Drivers</h2>" + table(jobs.drivers || [],
+                               ["job_id", "driver", "alive"]) +
     `<p><a href="/metrics">/metrics</a> (Prometheus) · ` +
     `<a href="/timeseries">/timeseries</a> (utilization) · ` +
     `<a href="/api/telemetry?format=text">/api/telemetry</a> ` +
@@ -104,10 +108,17 @@ def create_app(address: Optional[str] = None):
             json.loads(json.dumps(
                 await call(state_api.list_tasks, limit=limit), default=repr)))
 
-    async def jobs(_req):
+    async def jobs(req):
+        """/api/jobs — the multi-tenant job plane: per-job priority,
+        quota, live resource usage, state, submission time (plus the
+        internal driver registrations under "drivers").  ``?job=``
+        prefix-filters like `rt jobs`."""
+        overview = await call(state_api.jobs_overview,
+                              job_id=req.query.get("job") or None)
+        drivers = await call(state_api.list_jobs)
         return web.json_response(
-            json.loads(json.dumps(await call(state_api.list_jobs),
-                                  default=repr)))
+            json.loads(json.dumps({"jobs": overview,
+                                   "drivers": drivers}, default=repr)))
 
     async def objects(_req):
         return web.json_response(

@@ -28,6 +28,8 @@ class JobStatus:
     entrypoint: str = ""
     metadata: Optional[Dict[str, Any]] = None
     ts: float = 0.0
+    priority: int = 0
+    quota: Optional[Dict[str, float]] = None
 
     @property
     def is_terminal(self) -> bool:
@@ -50,9 +52,18 @@ class JobSubmissionClient:
                    submission_id: Optional[str] = None,
                    runtime_env: Optional[Dict[str, Any]] = None,
                    metadata: Optional[Dict[str, Any]] = None,
-                   num_cpus: float = 0) -> str:
+                   num_cpus: float = 0,
+                   priority: int = 0,
+                   quota: Optional[Dict[str, float]] = None) -> str:
         """Start ``entrypoint`` under a detached supervisor actor;
-        returns the job id immediately."""
+        returns the job id immediately.
+
+        ``priority`` (int, default 0, higher wins) orders gang
+        admission across jobs, and a higher-priority job may preempt
+        a lower one's gangs when the cluster is full.  ``quota``
+        optionally caps the job's total resource footprint (e.g.
+        ``{"CPU": 4}``); over-quota lease/gang requests wait until the
+        job's own usage drops."""
         import ray_tpu
 
         from .supervisor import JobSupervisor
@@ -62,6 +73,18 @@ class JobSubmissionClient:
             raise ValueError(
                 f"invalid submission_id {job_id!r}: use letters, digits, "
                 f"'_', '-', '.' (it becomes a KV key segment)")
+        priority = int(priority)
+        if quota is not None:
+            if not isinstance(quota, dict) or not quota:
+                raise ValueError(f"quota must be a non-empty dict of "
+                                 f"resource caps, got {quota!r}")
+            bad = {k: v for k, v in quota.items()
+                   if not isinstance(k, str)
+                   or not isinstance(v, (int, float)) or v <= 0}
+            if bad:
+                raise ValueError(f"invalid quota entries {bad!r}: "
+                                 f"caps must be positive numbers")
+            quota = {k: float(v) for k, v in quota.items()}
         existing = self._status_raw(job_id)
         if existing is not None:
             raise ValueError(f"job {job_id!r} already exists")
@@ -76,7 +99,7 @@ class JobSubmissionClient:
             opts["runtime_env"] = runtime_env
         actor_cls = ray_tpu.remote(JobSupervisor)
         actor = actor_cls.options(**opts).remote(
-            job_id, entrypoint, metadata)
+            job_id, entrypoint, metadata, priority, quota)
         # Surface scheduling failures at submit time: the supervisor
         # writes PENDING from __init__, so a ping proves liveness.
         ray_tpu.get(actor.ping.remote(), timeout=120)
@@ -117,7 +140,9 @@ class JobSubmissionClient:
                          message=raw.get("message", ""),
                          entrypoint=raw.get("entrypoint", ""),
                          metadata=raw.get("metadata"),
-                         ts=raw.get("ts", 0.0))
+                         ts=raw.get("ts", 0.0),
+                         priority=raw.get("priority", 0),
+                         quota=raw.get("quota"))
 
     def _supervisor_alive(self, job_id: str) -> bool:
         import ray_tpu

@@ -22,17 +22,29 @@ class JobSupervisor:
     """Detached actor; one instance per submitted job."""
 
     def __init__(self, job_id: str, entrypoint: str,
-                 metadata: dict | None = None):
+                 metadata: dict | None = None,
+                 priority: int = 0,
+                 quota: dict | None = None):
         from ray_tpu.core import runtime as _rt
 
         self.job_id = job_id
         self.entrypoint = entrypoint
         self.metadata = metadata or {}
+        self.priority = int(priority or 0)
+        self.quota = dict(quota) if quota else None
         self._rt = _rt.get_runtime()
         self._proc: subprocess.Popen | None = None
         self._stopped = False
         self._log_buf = bytearray()
         self._log_lock = threading.Lock()
+        # Register the multi-tenant metadata BEFORE the first status
+        # write (and long before the entrypoint spawns), so admission
+        # and quota decisions never race the job's first lease/gang
+        # request.
+        self._rt.controller_call("job_register", {
+            "job_id": job_id, "priority": self.priority,
+            "quota": self.quota, "entrypoint": entrypoint,
+            "ts": time.time()})
         self._set_status("PENDING")
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -55,6 +67,7 @@ class JobSupervisor:
         self._kv("status", json.dumps({
             "status": status, "message": message,
             "entrypoint": self.entrypoint, "metadata": self.metadata,
+            "priority": self.priority, "quota": self.quota,
             "ts": time.time()}).encode())
 
     def _push_logs(self) -> None:
@@ -87,6 +100,9 @@ class JobSupervisor:
             return
         env = dict(os.environ)
         env["RT_JOB_ID"] = self.job_id
+        # The entrypoint's gangs compete for admission at the job's
+        # priority (placement_group() reads this by default).
+        env["RT_JOB_PRIORITY"] = str(self.priority)
         try:
             self._proc = subprocess.Popen(
                 self.entrypoint, shell=True, env=env,

@@ -601,10 +601,20 @@ def _cmd_job_inner(args) -> int:
         if not ep:
             print("error: no entrypoint given", file=sys.stderr)
             return 2
+        quota = None
+        if args.quota:
+            try:
+                quota = json.loads(args.quota)
+            except json.JSONDecodeError as e:
+                print(f"error: --quota must be JSON "
+                      f"(e.g. '{{\"CPU\": 4}}'): {e}", file=sys.stderr)
+                return 2
         job_id = client.submit_job(
             entrypoint=" ".join(ep),
             submission_id=args.id or None,
-            runtime_env=renv or None)
+            runtime_env=renv or None,
+            priority=args.priority,
+            quota=quota)
         print(f"Submitted {job_id}")
         if args.wait:
             st = client.wait_until_finished(job_id,
@@ -630,6 +640,53 @@ def _cmd_job_inner(args) -> int:
             print(f"{st.job_id}  {st.status:<10} {st.entrypoint}")
         return 0
     return 2
+
+
+def cmd_jobs(args) -> int:
+    """The multi-tenant job plane: every submitted job with priority,
+    quota, live resource usage, state, and submission time — the "who
+    is paying for this cluster" view (prefix-match job ids like
+    `rt explain` does)."""
+    from ray_tpu.util import state as state_api
+
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    rows = state_api.jobs_overview(args.job_id or None, address=address)
+    if args.format == "json":
+        print(json.dumps(rows, indent=2, default=repr))
+        return 0
+    if not rows:
+        print("(no submitted jobs)" + (f" matching {args.job_id!r}"
+                                       if args.job_id else ""))
+        return 0
+
+    def _res(d):
+        return ",".join(f"{k}={v:g}" for k, v in sorted(d.items())) \
+            if d else "-"
+
+    now = time.time()
+    table = []
+    for r in rows:
+        age = now - r["submitted"] if r.get("submitted") else 0.0
+        state = r.get("state", "?")
+        if r.get("preempting"):
+            state += "(PREEMPTING)"
+        table.append({
+            "job_id": r["job_id"], "pri": r.get("priority", 0),
+            "state": state, "quota": _res(r.get("quota")),
+            "usage": _res(r.get("usage")),
+            "submitted": f"{age:.0f}s ago",
+            "entrypoint": (r.get("entrypoint") or "")[:48]})
+    cols = ["job_id", "pri", "state", "quota", "usage", "submitted",
+            "entrypoint"]
+    widths = {c: max(len(c), *(len(str(t[c])) for t in table))
+              for c in cols}
+    print("  ".join(c.ljust(widths[c]) for c in cols))
+    for t in table:
+        print("  ".join(str(t[c]).ljust(widths[c]) for c in cols))
+    return 0
 
 
 def cmd_logs(args) -> int:
@@ -886,6 +943,16 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--port", type=int, default=8265)
     sp.set_defaults(fn=cmd_dashboard)
 
+    sp = sub.add_parser("jobs",
+                        help="multi-tenant job plane: priority, quota, "
+                             "usage, state per submitted job")
+    sp.add_argument("job_id", nargs="?", default="",
+                    help="job id prefix filter (optional)")
+    sp.add_argument("--address", default="")
+    sp.add_argument("--format", choices=["table", "json"],
+                    default="table")
+    sp.set_defaults(fn=cmd_jobs)
+
     sp = sub.add_parser("logs",
                         help="fetch worker/actor logs from node agents")
     sp.add_argument("--worker", default="",
@@ -939,6 +1006,12 @@ def _build_parser() -> argparse.ArgumentParser:
     j.add_argument("--working-dir", default="")
     j.add_argument("--env", action="append", default=[],
                    metavar="K=V")
+    j.add_argument("--priority", type=int, default=0,
+                   help="job priority (higher wins gang admission and "
+                        "may preempt lower-priority jobs; default 0)")
+    j.add_argument("--quota", default="",
+                   help="per-job resource caps as JSON, e.g. "
+                        "'{\"CPU\": 4, \"TPU\": 8}'")
     j.add_argument("--wait", action="store_true",
                    help="block until the job finishes; print its logs")
     j.add_argument("--timeout", type=float, default=3600)

@@ -137,7 +137,8 @@ class BaseTrainer:
                     info, queue, start_ckpt.path if start_ckpt else None,
                     shard_specs[w.rank],
                     self.run_config.name or "train_run",
-                    self.run_config.telemetry))
+                    self.run_config.telemetry,
+                    os.environ.get("RT_JOB_ID", "")))
             final_metrics: Dict = {}
             pending = list(refs)
             self._drain_notice = None
@@ -245,25 +246,45 @@ class BaseTrainer:
                 if not n.get("draining") or nid not in gang_nodes \
                         or nid in notices:
                     continue
-                notice = notices[nid] = {
+                self._register_notice(notices, nid, {
                     "node_id": nid,
                     "reason": n.get("drain_reason", ""),
-                    "deadline": n.get("drain_deadline", 0.0)}
-                if self._drain_notice is None:
-                    self._drain_notice = notice
-                # EVERY new notice reaches the queue — it keeps the
-                # one with the earliest deadline, so a tighter notice
-                # arriving later still reaches the workers.
-                try:
-                    ray_tpu.get(queue.set_interrupt.remote(notice))
-                except Exception:
-                    pass  # queue gone == gang already dying
-                from ..util import flight_recorder
-
-                flight_recorder.record("train_drain_notice", **notice)
+                    "deadline": n.get("drain_deadline", 0.0)}, queue)
+            # Job-level preemption notice (multi-tenant plane): a
+            # higher-priority gang selected THIS job as a victim.  The
+            # notice carries a remaining-seconds deadline (the node-
+            # drain clock discipline) and drives the same interrupt
+            # flag, so rank 0 checkpoint-on-notice works unchanged.
+            job = os.environ.get("RT_JOB_ID", "")
+            if job and f"job:{job}" not in notices:
+                r = rt.controller_call("job_preemption_state",
+                                       {"job_id": job})
+                if r and r.get("preempting"):
+                    self._register_notice(notices, f"job:{job}", {
+                        "node_id": "",
+                        "job": job,
+                        "reason": r.get("reason")
+                        or f"job {job} preempted",
+                        "deadline": time.time()
+                        + float(r.get("remaining_s") or 0.0)}, queue)
         except Exception:
             return self._drain_notice  # polling must never fail fit
         return self._drain_notice
+
+    def _register_notice(self, notices, key, notice, queue) -> None:
+        notices[key] = notice
+        if self._drain_notice is None:
+            self._drain_notice = notice
+        # EVERY new notice reaches the queue — it keeps the one with
+        # the earliest deadline, so a tighter notice arriving later
+        # still reaches the workers.
+        try:
+            ray_tpu.get(queue.set_interrupt.remote(notice))
+        except Exception:
+            pass  # queue gone == gang already dying
+        from ..util import flight_recorder
+
+        flight_recorder.record("train_drain_notice", **notice)
 
     def _drain(self, queue, manager: CheckpointManager,
                history: list) -> None:
@@ -319,11 +340,19 @@ class BaseTrainer:
 
 
 def _worker_entry(train_loop, config, rank, world, local_info, queue,
-                  ckpt_path, shards, experiment_name, telemetry=None):
+                  ckpt_path, shards, experiment_name, telemetry=None,
+                  job_id=""):
     """Runs inside the worker actor: set up the session, run user code."""
     from . import session as session_mod
     from .checkpoint import Checkpoint
 
+    if job_id:
+        # Per-job goodput attribution: the worker process was spawned
+        # by the node agent (not the job's entrypoint), so the
+        # submitted-job identity travels with the gang, not the env.
+        from ..util import goodput as goodput_mod
+
+        goodput_mod.set_job_id(job_id)
     session_mod.init_session(
         world_rank=rank, world_size=world,
         local_rank=local_info["local_rank"],

@@ -403,6 +403,70 @@ def find_infeasible_pgs(pgs: List[Dict], nodes: List[Dict]
     return out
 
 
+def find_starved_jobs(pgs: List[Dict], now: float,
+                      warn_s: float = 60.0) -> List[Dict]:
+    """Multi-tenant starvation: a gang (placement-group) request
+    pending longer than ``warn_s`` yields a warning naming the job,
+    its priority, why it waits (no capacity / over quota / parked
+    behind a higher-priority gang), and the jobs holding the
+    contested resources — with the next probe (`rt jobs`, a quota
+    bump, or preemption).  CRITICAL when the starved job outranks
+    every holder: priority inversion means the admission/preemption
+    plane is wedged (or preemption is disabled)."""
+    holders: Dict[str, int] = {}
+    for pg in pgs or []:
+        if pg.get("state") == "CREATED" and pg.get("job"):
+            job = pg["job"]
+            holders[job] = max(holders.get(job, -10**9),
+                               int(pg.get("priority", 0)))
+    out = []
+    for pg in pgs or []:
+        if pg.get("state") not in ("PENDING", "RESCHEDULING"):
+            continue
+        since = float(pg.get("pending_since") or 0.0) or \
+            float(pg.get("create_time") or 0.0)
+        if not since:
+            continue
+        age = now - since
+        if age <= warn_s:
+            continue
+        job = pg.get("job") or "?"
+        pri = int(pg.get("priority", 0))
+        reason = pg.get("pending_reason") or "no_capacity"
+        other = {j: p for j, p in holders.items() if j != pg.get("job")}
+        outranks_all = bool(other) and all(pri > p
+                                           for p in other.values())
+        held_by = ", ".join(f"{j} (priority {p})"
+                            for j, p in sorted(other.items(),
+                                               key=lambda kv: -kv[1]))
+        if reason == "over_quota":
+            probe = f"rt jobs {job}; raise the job's quota or free " \
+                    f"its own usage"
+        elif outranks_all:
+            probe = "rt jobs; check RT_JOB_PREEMPTION_ENABLED — this " \
+                    "job should be preempting a holder"
+        else:
+            probe = "rt jobs; rt list placement-groups; bump the " \
+                    "job's priority or add capacity"
+        out.append(_finding(
+            "starved_job",
+            "critical" if outranks_all else "warning",
+            f"job {job} (priority {pri}) has a gang pending for "
+            f"{age:.0f}s ({reason})"
+            + (f"; resources held by {held_by}" if held_by else ""),
+            detail="the gang either fully admits or fully waits; a "
+                   "wait this long means capacity is contested, the "
+                   "job is over its quota, or it is parked behind a "
+                   "higher-priority gang."
+            + (" The starved job outranks every holder — preemption "
+               "should have fired." if outranks_all else ""),
+            probe=probe,
+            data={"job": job, "priority": pri, "age_s": age,
+                  "reason": reason, "holders": other,
+                  "pg_id": str(pg.get("pg_id", "?"))}))
+    return out
+
+
 def find_autoscaler_gaps(decisions: List[Dict], now: float,
                          horizon_s: float = 300.0) -> List[Dict]:
     """Recent autoscaler ticks that saw demand no launchable node
@@ -459,7 +523,8 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
              collective_watchdog_s: float = 30.0,
              stuck_task_min_s: float = 60.0,
              stuck_task_p99_factor: float = 3.0,
-             straggler_threshold: float = 0.2) -> Dict[str, Any]:
+             straggler_threshold: float = 0.2,
+             starvation_warn_s: float = 60.0) -> Dict[str, Any]:
     """Pure aggregation of every check over already-fetched state
     (unit-testable without a cluster)."""
     now = time.time() if now is None else now
@@ -472,6 +537,7 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
     findings += find_draining_nodes(nodes, now)
     findings += find_lease_problems(ledgers, now)
     findings += find_infeasible_pgs(pgs, nodes)
+    findings += find_starved_jobs(pgs, now, warn_s=starvation_warn_s)
     findings += find_stuck_tasks(tasks, now, min_s=stuck_task_min_s,
                                  p99_factor=stuck_task_p99_factor)
     findings += find_stragglers(spans, threshold=straggler_threshold)
@@ -529,7 +595,8 @@ def cluster_diagnosis(*, address: Optional[str] = None
         collective_watchdog_s=config.collective_watchdog_s,
         stuck_task_min_s=config.stuck_task_min_s,
         stuck_task_p99_factor=config.stuck_task_p99_factor,
-        straggler_threshold=config.straggler_threshold)
+        straggler_threshold=config.straggler_threshold,
+        starvation_warn_s=config.starvation_warn_s)
 
 
 def render_text(diag: Dict[str, Any]) -> str:

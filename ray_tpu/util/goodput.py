@@ -44,6 +44,30 @@ PHASES = ("compute", "compile", "checkpoint", "checkpoint_on_notice",
 
 GAUGE_NAME = "rt_goodput_seconds"
 
+# Multi-tenant attribution: the submitted-job id stamped on every
+# published phase series ("who is paying for this cluster").  Defaults
+# from RT_JOB_ID (the supervisor exports it into the entrypoint);
+# train workers — spawned by node agents, not the entrypoint — get it
+# via set_job_id() from the gang bootstrap.
+_job_id: Optional[str] = None
+
+
+def set_job_id(job_id: str) -> None:
+    """Stamp all subsequently published goodput series with this
+    submitted-job id (and republish so the tag lands now)."""
+    global _job_id
+    _job_id = job_id or None
+    led = _ledger
+    if led is not None:
+        led._republish()
+
+
+def current_job_id() -> str:
+    import os
+
+    return _job_id if _job_id is not None \
+        else os.environ.get("RT_JOB_ID", "")
+
 
 class _PhaseSpan:
     """Re-entrant handle returned by ``phase()``; usable as a context
@@ -152,9 +176,13 @@ class GoodputLedger:
 
             g = Gauge(GAUGE_NAME,
                       "Cumulative wall-clock seconds per goodput phase.",
-                      tag_keys=("phase",))
+                      tag_keys=("phase", "job"))
+            job = current_job_id()
             for p, s in self.snapshot()["seconds"].items():
-                g.set(s, tags={"phase": p})
+                tags = {"phase": p}
+                if job:
+                    tags["job"] = job
+                g.set(s, tags=tags)
         except Exception:
             pass  # telemetry must never take down the training path
 
@@ -211,21 +239,31 @@ def summarize_sources(sources: Dict[str, List[Dict]]) -> Dict:
     Sums ``rt_goodput_seconds`` per phase across every reporting
     process; fractions normalize by the summed totals, so they sum to
     ~1.0 regardless of how many processes overlap in wall-clock.
+    Series carrying a ``job`` tag additionally aggregate into
+    ``per_job`` — the per-tenant cost attribution `rt jobs`/`rt
+    telemetry` surface.
     """
     seconds: Dict[str, float] = {}
     per_source: Dict[str, Dict[str, float]] = {}
+    per_job: Dict[str, Dict[str, float]] = {}
     for src, snaps in (sources or {}).items():
         for snap in snaps:
             if snap.get("name") != GAUGE_NAME:
                 continue
             mine = per_source.setdefault(src, {})
             for s in snap.get("series", []):
-                phase = (s.get("tags") or {}).get("phase", "?")
+                tags = s.get("tags") or {}
+                phase = tags.get("phase", "?")
                 v = float(s.get("value", 0.0))
                 seconds[phase] = seconds.get(phase, 0.0) + v
                 mine[phase] = v
+                job = tags.get("job")
+                if job:
+                    jp = per_job.setdefault(job, {})
+                    jp[phase] = jp.get(phase, 0.0) + v
     total = sum(seconds.values())
     fractions = ({p: s / total for p, s in seconds.items()}
                  if total > 0 else {})
     return {"total_seconds": total, "seconds": seconds,
-            "fractions": fractions, "per_source": per_source}
+            "fractions": fractions, "per_source": per_source,
+            "per_job": per_job}

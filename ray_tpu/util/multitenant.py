@@ -1,0 +1,126 @@
+"""Multi-tenant job-plane primitives: priorities, quotas, victims.
+
+The controller's gang admission path and the node agents' lease-grant
+path share one small vocabulary for multi-tenancy:
+
+  priority   an int per job (default 0, higher wins).  Gang admission
+             tries pending placement groups in priority order, FIFO
+             within a priority; when a high-priority gang cannot place,
+             a strictly-lower-priority victim is preempted through the
+             drain/checkpoint-on-notice machinery.
+  quota      optional per-job resource caps ({"CPU": 4, "TPU": 8}).
+             Enforced at admission time for placement groups
+             (controller) and at lease-grant time for plain leases
+             (agents, against the heartbeat-distributed usage view).
+             An over-quota request is REFUSED-but-queued: it grants as
+             soon as the job's usage drops below the cap.
+
+Everything here is pure (plain values in, plain values out) so the
+comparator, the quota accounting, and victim selection unit-test
+without a cluster; the controller/agent code wires these to live
+state.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+ResourceMap = Dict[str, float]
+
+_EPS = 1e-9
+
+
+# ------------------------------------------------------------- ordering
+def admission_key(priority: int, submit_ts: float):
+    """Sort key for PENDING gang admission: highest priority first,
+    FIFO (oldest first) within a priority."""
+    return (-int(priority), float(submit_ts))
+
+
+def victim_key(priority: int, submit_ts: float):
+    """Sort key for preemption victims: lowest priority first, and the
+    MOST RECENTLY submitted job first within a priority — the job that
+    has been running longest has the most sunk work, so it is the last
+    to be evicted at its priority tier."""
+    return (int(priority), -float(submit_ts))
+
+
+# ---------------------------------------------------------------- quota
+def quota_exceeded(quota: Optional[ResourceMap], used: ResourceMap,
+                   demand: ResourceMap) -> bool:
+    """True when granting ``demand`` on top of ``used`` would exceed
+    any capped resource.  Resources absent from the quota are
+    uncapped; a quota key the demand never touches costs nothing."""
+    if not quota:
+        return False
+    for key, cap in quota.items():
+        if used.get(key, 0.0) + demand.get(key, 0.0) > cap + _EPS:
+            return True
+    return False
+
+
+def overlay_usage(cluster_used: ResourceMap,
+                  reported_local: ResourceMap,
+                  live_local: ResourceMap) -> ResourceMap:
+    """Effective usage for a grant-time quota check on one node: the
+    controller's cluster-wide view, minus what this node last REPORTED
+    into that view, plus this node's LIVE books — so grants released
+    since the last heartbeat free headroom immediately, and
+    back-to-back local grants inside one heartbeat period can't
+    overshoot the cap."""
+    out = dict(cluster_used or {})
+    for k, v in (reported_local or {}).items():
+        out[k] = out.get(k, 0.0) - v
+    for k, v in (live_local or {}).items():
+        out[k] = out.get(k, 0.0) + v
+    return {k: max(v, 0.0) for k, v in out.items()}
+
+
+# ------------------------------------------------------ victim selection
+def merge_credits(dst: Dict[str, ResourceMap],
+                  src: Dict[str, ResourceMap]) -> Dict[str, ResourceMap]:
+    """Accumulate per-node resource credits (what a victim's eviction
+    would hand back, keyed by node id)."""
+    for node, res in src.items():
+        acc = dst.setdefault(node, {})
+        for k, v in res.items():
+            acc[k] = acc.get(k, 0.0) + v
+    return dst
+
+
+def select_victims(candidates: List[Dict],
+                   feasible_with: Callable[[Dict[str, ResourceMap]],
+                                           bool],
+                   requester_priority: int) -> List[str]:
+    """Pick the minimal ordered set of victim JOBS whose eviction makes
+    the blocked gang placeable.
+
+    ``candidates``: one dict per lower-priority job holding committed
+    gangs — {"job": str, "priority": int, "submit_ts": float,
+    "credits": {node_id: {resource: amount}}}.  Only jobs with
+    priority STRICTLY below ``requester_priority`` are eligible (equal
+    priority never preempts equal priority).
+
+    ``feasible_with(credits)``: does the blocked gang place if these
+    per-node credits were returned to the pool?  The caller supplies
+    it so the real planner (strategy-aware bin packing) decides
+    feasibility — this function only owns eligibility + ordering +
+    greedy accumulation.
+
+    Returns the job ids to preempt, in eviction order, or [] when even
+    evicting every eligible job would not help (preempting for an
+    infeasible gang is pure damage).
+    """
+    eligible = sorted(
+        (c for c in candidates
+         if int(c.get("priority", 0)) < requester_priority),
+        key=lambda c: victim_key(c.get("priority", 0),
+                                 c.get("submit_ts", 0.0)))
+    chosen: List[str] = []
+    credits: Dict[str, ResourceMap] = {}
+    for cand in eligible:
+        chosen.append(cand["job"])
+        merge_credits(credits, cand.get("credits") or {})
+        if feasible_with(credits):
+            return chosen
+    return []

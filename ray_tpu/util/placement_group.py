@@ -78,17 +78,34 @@ class PlacementGroup:
 
 def placement_group(bundles: List[Dict[str, float]],
                     strategy: str = "PACK",
-                    name: str = "") -> PlacementGroup:
+                    name: str = "",
+                    priority: Optional[int] = None,
+                    job_id: Optional[str] = None) -> PlacementGroup:
+    """``priority``/``job_id`` default from the submitted-job
+    environment (``RT_JOB_PRIORITY``/``RT_JOB_ID``, exported by the
+    job supervisor) so every gang a job creates competes for admission
+    at the job's priority — and is preemptible as that job — without
+    trainer code knowing multi-tenancy exists."""
+    import os
+
     if not bundles:
         raise ValueError("placement group needs at least one bundle")
     for b in bundles:
         if not b or any(v < 0 for v in b.values()):
             raise ValueError(f"invalid bundle {b!r}")
+    if priority is None:
+        try:
+            priority = int(os.environ.get("RT_JOB_PRIORITY", "0") or 0)
+        except ValueError:
+            priority = 0
+    if job_id is None:
+        job_id = os.environ.get("RT_JOB_ID", "")
     rt = _runtime_mod.get_runtime()
     pg_id = PlacementGroupID.from_random()
     r = rt.controller_call("create_placement_group", {
         "pg_id": pg_id, "bundles": [dict(b) for b in bundles],
-        "strategy": strategy, "name": name})
+        "strategy": strategy, "name": name,
+        "priority": int(priority), "job": job_id})
     if not r.get("ok"):
         raise ValueError(r.get("error", "placement group creation failed"))
     return PlacementGroup(pg_id, list(bundles), strategy, name)

@@ -89,6 +89,28 @@ def list_jobs(*, address: Optional[str] = None) -> List[Dict]:
     return _call("list_jobs", {}, address)["jobs"]
 
 
+def jobs_overview(job_id: Optional[str] = None, *,
+                  address: Optional[str] = None) -> List[Dict]:
+    """The multi-tenant job plane (`rt jobs` / /api/jobs): every
+    submitted job with priority, quota, live resource usage, state,
+    submission time, and any active preemption notice.  ``job_id``
+    prefix-filters (the `rt explain` convention)."""
+    return _call("jobs_overview", {"job_id": job_id or ""},
+                 address)["jobs"]
+
+
+def preempt_job(job_id: str, *, reason: str = "operator preemption",
+                grace_s: Optional[float] = None,
+                address: Optional[str] = None) -> Dict[str, Any]:
+    """Mark a job for preemption (checkpoint-on-notice, then gang
+    eviction at the grace deadline) — the operator-driven path the
+    scheduler's automatic victim selection also uses."""
+    payload: Dict[str, Any] = {"job_id": job_id, "reason": reason}
+    if grace_s is not None:
+        payload["grace_s"] = grace_s
+    return _call("preempt_job", payload, address)
+
+
 def list_placement_groups(*, address: Optional[str] = None) -> List[Dict]:
     pgs = _call("list_placement_groups", {}, address)
     return [dict(p) for p in pgs] if isinstance(pgs, list) else pgs

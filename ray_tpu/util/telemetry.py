@@ -82,8 +82,16 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
     train: Dict[str, Dict[str, Any]] = {}
     collectives: List[Dict[str, Any]] = []
     serve: Dict[str, Any] = {}
+    object_store = {"spilled_bytes": 0.0, "spill_total": 0.0,
+                    "restore_total": 0.0}
     for src, snap in _iter_metrics(sources):
         name = snap.get("name", "")
+        if name in ("rt_object_spilled_bytes", "rt_object_spill_total",
+                    "rt_object_restore_total"):
+            key = name.replace("rt_object_", "")
+            for s in snap.get("series", []):
+                object_store[key] += float(s.get("value", 0.0))
+            continue
         if name in TRAIN_GAUGES:
             row = train.setdefault(src, {})
             for s in snap.get("series", []):
@@ -138,6 +146,7 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
         "train_series": series,
         "collectives": collectives,
         "serve": serve,
+        "object_store": object_store,
         "flight": raw.get("flight", []),
     }
 
@@ -162,6 +171,19 @@ def render_text(summary: Dict[str, Any]) -> str:
     for phase in sorted(fracs, key=lambda p: -fracs[p]):
         lines.append(f"  {phase:<11} {100 * fracs[phase]:6.2f}%  "
                      f"({gp['seconds'][phase]:.2f}s)")
+    per_job = gp.get("per_job") or {}
+    if per_job:
+        lines.append("\nPer-job goodput (who is paying for this "
+                     "cluster):")
+        for job in sorted(per_job,
+                          key=lambda j: -sum(per_job[j].values())):
+            phases = per_job[job]
+            total = sum(phases.values())
+            top = "  ".join(
+                f"{p}={s:.1f}s"
+                for p, s in sorted(phases.items(), key=lambda kv:
+                                   -kv[1]) if s > 0)[:100]
+            lines.append(f"  {job:<24} {total:8.1f}s   {top}")
 
     train = summary.get("train", {})
     if train:
@@ -214,6 +236,13 @@ def render_text(summary: Dict[str, Any]) -> str:
                          f"{h['mean'] * 1e3:.1f}ms  p99≤"
                          f"{h['p99'] * 1e3:.1f}ms")
         lines.append(f"  in-flight now: {serve.get('inflight', 0):.0f}")
+
+    objs = summary.get("object_store") or {}
+    if any(objs.values()):
+        lines.append("\nObject store:")
+        lines.append(f"  spilled now   {_fmt_rate(objs['spilled_bytes'])}B")
+        lines.append(f"  spills total  {objs['spill_total']:.0f}")
+        lines.append(f"  restores      {objs['restore_total']:.0f}")
 
     flights = summary.get("flight", [])
     if flights:

@@ -1,0 +1,517 @@
+"""Multi-tenant job plane units (ISSUE 6): the priority comparator,
+quota accounting (grant/release/over-quota refusal), victim selection,
+priority-ordered gang admission with a quota gate, controller
+preemption bookkeeping, starved-job doctor findings, and per-job
+goodput attribution — all without a live cluster (fake agents stand in
+for nodes; the slow chaos acceptance lives in
+test_multitenant_cluster.py).
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from ray_tpu.util import multitenant
+from ray_tpu.util.multitenant import (admission_key, overlay_usage,
+                                      quota_exceeded, select_victims,
+                                      victim_key)
+
+
+# ------------------------------------------------------------ comparator
+def test_admission_key_orders_by_priority_then_fifo():
+    rows = [("lo-old", admission_key(0, 100.0)),
+            ("hi-new", admission_key(5, 300.0)),
+            ("lo-new", admission_key(0, 200.0)),
+            ("hi-old", admission_key(5, 50.0))]
+    ordered = [name for name, key in sorted(rows, key=lambda r: r[1])]
+    assert ordered == ["hi-old", "hi-new", "lo-old", "lo-new"]
+
+
+def test_victim_key_prefers_lowest_priority_then_newest():
+    rows = [("lo-old", victim_key(0, 100.0)),
+            ("lo-new", victim_key(0, 200.0)),
+            ("mid", victim_key(3, 50.0))]
+    ordered = [name for name, key in sorted(rows, key=lambda r: r[1])]
+    # Lowest priority first; within a priority the NEWEST submission
+    # is evicted first (least sunk work).
+    assert ordered == ["lo-new", "lo-old", "mid"]
+
+
+# ----------------------------------------------------------------- quota
+def test_quota_exceeded_only_on_capped_resources():
+    assert not quota_exceeded(None, {"CPU": 99}, {"CPU": 1})
+    assert not quota_exceeded({"CPU": 4}, {"CPU": 2}, {"CPU": 2})
+    assert quota_exceeded({"CPU": 4}, {"CPU": 2}, {"CPU": 2.5})
+    # TPU is uncapped here: only CPU counts against the quota.
+    assert not quota_exceeded({"CPU": 4}, {"TPU": 100}, {"TPU": 8})
+    assert quota_exceeded({"CPU": 4, "TPU": 8}, {"TPU": 8},
+                          {"TPU": 0.5})
+
+
+def test_grant_release_accounting_through_overlay():
+    """The lease-grant accounting the agent runs: cluster view, minus
+    what this node reported into it, plus this node's live books."""
+    quota = {"CPU": 4}
+    # Grant path: two local grants since the last report both count.
+    used = overlay_usage({"CPU": 2}, {"CPU": 2}, {"CPU": 4})
+    assert used == {"CPU": 4}
+    assert quota_exceeded(quota, used, {"CPU": 0.5})   # refusal
+    # Release path: a lease returned since the report frees headroom
+    # IMMEDIATELY, before the controller's view catches up.
+    used = overlay_usage({"CPU": 4}, {"CPU": 4}, {"CPU": 2})
+    assert used == {"CPU": 2}
+    assert not quota_exceeded(quota, used, {"CPU": 2})  # grants again
+    # Another node's usage is preserved by the overlay.
+    used = overlay_usage({"CPU": 3}, {"CPU": 1}, {"CPU": 1})
+    assert used == {"CPU": 3}
+    # Never negative, even if the view lags a big local release.
+    assert overlay_usage({"CPU": 1}, {"CPU": 3}, {}) == {"CPU": 0.0}
+
+
+# ------------------------------------------------------- victim selection
+def _cand(job, pri, ts, node, cpu):
+    return {"job": job, "priority": pri, "submit_ts": ts,
+            "credits": {node: {"CPU": float(cpu)}}}
+
+
+def test_select_victims_minimal_set_and_ordering():
+    # Need 2 CPUs on n1.  lo-new frees 2 on n1 -> single victim, and
+    # it outranks (as a victim) the older equal-priority job.
+    cands = [_cand("lo-old", 0, 100.0, "n1", 2),
+             _cand("lo-new", 0, 200.0, "n1", 2),
+             _cand("mid", 3, 50.0, "n1", 2)]
+
+    def feasible(credits):
+        return credits.get("n1", {}).get("CPU", 0.0) >= 2.0
+
+    assert select_victims(cands, feasible, requester_priority=5) == \
+        ["lo-new"]
+
+
+def test_select_victims_accumulates_until_feasible():
+    cands = [_cand("a", 0, 300.0, "n1", 1),
+             _cand("b", 0, 200.0, "n1", 1),
+             _cand("c", 0, 100.0, "n1", 1)]
+
+    def feasible(credits):
+        return credits.get("n1", {}).get("CPU", 0.0) >= 2.0
+
+    # Newest first, stop as soon as the plan fits: a (ts 300) then b.
+    assert select_victims(cands, feasible, requester_priority=1) == \
+        ["a", "b"]
+
+
+def test_select_victims_never_preempts_equal_or_higher_priority():
+    cands = [_cand("peer", 5, 100.0, "n1", 4),
+             _cand("boss", 9, 100.0, "n1", 4)]
+    assert select_victims(cands, lambda c: True,
+                          requester_priority=5) == []
+
+
+def test_select_victims_empty_when_infeasible_even_with_all():
+    cands = [_cand("a", 0, 100.0, "n1", 1)]
+    assert select_victims(cands, lambda c: False,
+                          requester_priority=5) == []
+
+
+# ------------------------------------------ controller + placement units
+def _make_controller(**overrides):
+    from ray_tpu.core.config import RuntimeConfig
+    from ray_tpu.core.controller import Controller, NodeEntry
+    from ray_tpu.core.ids import NodeID
+    from ray_tpu.core.placement import PlacementGroupManager
+
+    config = RuntimeConfig.from_env(overrides={
+        "preempt_pending_s": 0.05, "preemption_grace_s": 0.3,
+        **overrides})
+    ctl = Controller(config, "mt_unit")
+    ctl._placement = PlacementGroupManager(ctl)
+
+    class _FakeAgent:
+        """Accepts bundles against the controller's node row (the real
+        agent's reserve/return accounting, collapsed)."""
+
+        def __init__(self, nid):
+            self.nid = nid
+            self.bundles = {}
+            self.preempted = []
+
+        async def call(self, method, p):
+            node = ctl.nodes[self.nid]
+            if method == "prepare_bundle":
+                res = p["resources"]
+                avail = node.resources_available
+                if all(avail.get(k, 0.0) + 1e-9 >= v
+                       for k, v in res.items()):
+                    for k, v in res.items():
+                        avail[k] = avail.get(k, 0.0) - v
+                    self.bundles[(p["pg_id"], p["bundle_index"])] = res
+                    return {"ok": True}
+                return {"ok": False}
+            if method == "return_bundle":
+                res = self.bundles.pop(
+                    (p["pg_id"], p["bundle_index"]), None)
+                if res:
+                    for k, v in res.items():
+                        node.resources_available[k] = \
+                            node.resources_available.get(k, 0.0) + v
+                return {"ok": True}
+            if method == "preempt_pg_leases":
+                self.preempted.append(p["pg_id"])
+                return {"ok": True}
+            return {"ok": True}
+
+    agents = {}
+
+    def add_node(cpu):
+        nid = NodeID.from_random()
+        ctl.nodes[nid] = NodeEntry(
+            node_id=nid, agent_addr=f"127.0.0.1:{len(agents) + 1}",
+            resources_total={"CPU": float(cpu)},
+            resources_available={"CPU": float(cpu)},
+            last_heartbeat=time.time())
+        agents[nid] = _FakeAgent(nid)
+        return nid
+
+    async def _agent(nid):
+        return agents.get(nid)
+
+    ctl._agent = _agent
+    return ctl, add_node, agents
+
+
+def _mk_pg(ctl, bundles, priority=0, job="", strategy="PACK"):
+    from ray_tpu.core.ids import PlacementGroupID
+
+    pg_id = PlacementGroupID.from_random()
+
+    async def _create():
+        r = await ctl._placement.create({
+            "pg_id": pg_id, "bundles": bundles, "strategy": strategy,
+            "priority": priority, "job": job})
+        assert r["ok"], r
+        return pg_id
+
+    return _create(), pg_id
+
+
+async def _wait_state(ctl, pg_id, state, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        entry = ctl._placement._groups[pg_id]
+        if entry.state == state:
+            return entry
+        await asyncio.sleep(0.02)
+    raise TimeoutError(
+        f"pg {pg_id} never reached {state} "
+        f"(now {ctl._placement._groups[pg_id].state})")
+
+
+def test_gang_admission_is_priority_ordered():
+    async def _run():
+        ctl, add_node, _agents = _make_controller(
+            job_preemption_enabled=False)
+        add_node(2)
+        add_node(2)
+        coro, a = _mk_pg(ctl, [{"CPU": 2.0}, {"CPU": 2.0}],
+                         strategy="SPREAD")
+        await coro
+        await _wait_state(ctl, a, "CREATED")
+        # Cluster full: a low-pri and then a high-pri gang queue up.
+        coro, lo = _mk_pg(ctl, [{"CPU": 2.0}, {"CPU": 2.0}], priority=0,
+                          strategy="SPREAD")
+        await coro
+        coro, hi = _mk_pg(ctl, [{"CPU": 2.0}, {"CPU": 2.0}], priority=7,
+                          strategy="SPREAD")
+        await coro
+        await asyncio.sleep(0.3)
+        assert ctl._placement._groups[lo].state == "PENDING"
+        assert ctl._placement._groups[hi].state == "PENDING"
+        # Capacity frees: the HIGH priority gang admits even though
+        # the low one queued first; the low one is parked behind it.
+        await ctl._placement.remove({"pg_id": a})
+        await _wait_state(ctl, hi, "CREATED")
+        lo_entry = ctl._placement._groups[lo]
+        assert lo_entry.state == "PENDING"
+        assert lo_entry.pending_reason in ("behind_higher_priority",
+                                           "no_capacity")
+
+    asyncio.run(_run())
+
+
+def test_blocked_high_priority_gang_preempts_lower_job():
+    async def _run():
+        ctl, add_node, agents = _make_controller()
+        add_node(2)
+        add_node(2)
+        await ctl.job_register({"job_id": "lo-job", "priority": 0})
+        await ctl.job_register({"job_id": "hi-job", "priority": 9})
+        coro, lo = _mk_pg(ctl, [{"CPU": 2.0}, {"CPU": 2.0}],
+                          priority=0, job="lo-job", strategy="SPREAD")
+        await coro
+        await _wait_state(ctl, lo, "CREATED")
+        coro, hi = _mk_pg(ctl, [{"CPU": 2.0}, {"CPU": 2.0}],
+                          priority=9, job="hi-job", strategy="SPREAD")
+        await coro
+        # Past preempt_pending_s the admission loop selects lo-job.
+        deadline = time.time() + 5
+        while "lo-job" not in ctl.preempting and time.time() < deadline:
+            await asyncio.sleep(0.02)
+        assert "lo-job" in ctl.preempting, ctl.preempting
+        st = await ctl.job_preemption_state({"job_id": "lo-job"})
+        assert st["preempting"] and st["remaining_s"] > 0
+        assert "hi-job" in st["reason"]
+        # Enforcement (the deadline loop's action): evict lo's gangs.
+        await ctl._placement.preempt_job_groups("lo-job",
+                                                reason="unit test")
+        assert any(a.preempted for a in agents.values())
+        assert ctl._placement._groups[lo].state == "REMOVED"
+        await _wait_state(ctl, hi, "CREATED")
+
+    asyncio.run(_run())
+
+
+def test_no_preemption_when_gang_infeasible_or_no_lower_priority():
+    async def _run():
+        ctl, add_node, _agents = _make_controller()
+        add_node(2)
+        await ctl.job_register({"job_id": "lo-job", "priority": 5})
+        coro, lo = _mk_pg(ctl, [{"CPU": 2.0}], priority=5, job="lo-job")
+        await coro
+        await _wait_state(ctl, lo, "CREATED")
+        # Equal priority: never a victim.
+        coro, peer = _mk_pg(ctl, [{"CPU": 2.0}], priority=5,
+                            job="peer-job")
+        await coro
+        await asyncio.sleep(0.4)
+        assert ctl.preempting == {}
+        # Higher priority but infeasible even on an empty cluster:
+        # preempting would be pure damage.
+        coro, big = _mk_pg(ctl, [{"CPU": 64.0}], priority=9,
+                           job="big-job")
+        await coro
+        await asyncio.sleep(0.4)
+        assert ctl.preempting == {}
+
+    asyncio.run(_run())
+
+
+def test_quota_gates_gang_admission_without_blocking_others():
+    async def _run():
+        ctl, add_node, _agents = _make_controller()
+        add_node(4)
+        await ctl.job_register({"job_id": "capped", "priority": 0,
+                                "quota": {"CPU": 2}})
+        coro, first = _mk_pg(ctl, [{"CPU": 2.0}], job="capped")
+        await coro
+        await _wait_state(ctl, first, "CREATED")
+        # Second gang would run the job over its 2-CPU quota: it
+        # waits with reason over_quota despite free capacity...
+        coro, second = _mk_pg(ctl, [{"CPU": 2.0}], job="capped")
+        await coro
+        await asyncio.sleep(0.3)
+        entry = ctl._placement._groups[second]
+        assert entry.state == "PENDING"
+        assert entry.pending_reason == "over_quota"
+        # ...and does NOT gate other jobs' admission.
+        coro, other = _mk_pg(ctl, [{"CPU": 2.0}], job="other")
+        await coro
+        await _wait_state(ctl, other, "CREATED")
+        # Releasing the first gang frees quota; the second admits.
+        await ctl._placement.remove({"pg_id": first})
+        await _wait_state(ctl, second, "CREATED")
+
+    asyncio.run(_run())
+
+
+def test_jobs_overview_merges_plane_kv_and_usage():
+    async def _run():
+        ctl, add_node, _agents = _make_controller()
+        add_node(4)
+        await ctl.job_register({"job_id": "train-lo", "priority": 0,
+                                "quota": {"CPU": 3},
+                                "entrypoint": "python train.py"})
+        await ctl.kv_put({"key": "job/train-lo/status",
+                          "value": json.dumps(
+                              {"status": "RUNNING",
+                               "ts": time.time()}).encode()})
+        coro, pg = _mk_pg(ctl, [{"CPU": 2.0}], job="train-lo")
+        await coro
+        await _wait_state(ctl, pg, "CREATED")
+        rows = (await ctl.jobs_overview({}))["jobs"]
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["job_id"] == "train-lo"
+        assert row["priority"] == 0
+        assert row["quota"] == {"CPU": 3}
+        assert row["usage"] == {"CPU": 2.0}
+        assert row["state"] == "RUNNING"
+        assert row["entrypoint"] == "python train.py"
+        # Prefix match (the rt explain convention) + miss.
+        assert (await ctl.jobs_overview({"job_id": "train"}))["jobs"]
+        assert not (await ctl.jobs_overview({"job_id": "zzz"}))["jobs"]
+        # An active preemption notice surfaces on the row.
+        await ctl.preempt_job({"job_id": "train-lo", "reason": "unit",
+                               "grace_s": 30})
+        row = (await ctl.jobs_overview({}))["jobs"][0]
+        assert row["preempting"]["remaining_s"] > 0
+
+    asyncio.run(_run())
+
+
+def test_heartbeat_distributes_quota_view_and_aggregates_usage():
+    async def _run():
+        ctl, add_node, _agents = _make_controller()
+        nid = add_node(4)
+        await ctl.job_register({"job_id": "capped", "priority": 2,
+                                "quota": {"CPU": 2}})
+        r = await ctl.register_job({"driver": "pid-1",
+                                    "tenant": "capped"})
+        from ray_tpu.core.ids import JobID
+
+        job_hex = JobID.from_int(r["job_id"]).hex()
+        hb = await ctl.heartbeat({
+            "node_id": nid,
+            "available": {"CPU": 3.0},
+            "job_usage": {job_hex: {"CPU": 1.0}}})
+        assert hb["ok"]
+        view = hb["jobs"][job_hex]
+        assert view["job"] == "capped"
+        assert view["priority"] == 2
+        assert view["quota"] == {"CPU": 2}
+        # The agent-reported plain lease rolls into the job's usage.
+        assert (await ctl.jobs_overview({}))["jobs"][0]["usage"] == \
+            {"CPU": 1.0}
+
+    asyncio.run(_run())
+
+
+# ------------------------------------------------------ doctor starvation
+def _pg_row(job, pri, state, since, reason="no_capacity",
+            create=None):
+    return {"pg_id": f"pg-{job}-{pri}", "job": job, "priority": pri,
+            "state": state, "pending_since": since,
+            "pending_reason": reason,
+            "create_time": create or since, "bundles": [{"CPU": 2.0}]}
+
+
+def test_find_starved_jobs_warning_names_holders():
+    from ray_tpu.util.doctor import find_starved_jobs
+
+    now = 1000.0
+    pgs = [_pg_row("holder-a", 5, "CREATED", 0.0),
+           _pg_row("starved", 1, "PENDING", now - 120.0)]
+    out = find_starved_jobs(pgs, now, warn_s=60.0)
+    assert len(out) == 1
+    f = out[0]
+    assert f["check"] == "starved_job"
+    assert f["severity"] == "warning"  # holder outranks the starved job
+    assert "starved" in f["summary"] and "priority 1" in f["summary"]
+    assert "holder-a" in f["summary"]
+    assert f["data"]["holders"] == {"holder-a": 5}
+
+
+def test_find_starved_jobs_critical_on_priority_inversion():
+    from ray_tpu.util.doctor import find_starved_jobs
+
+    now = 1000.0
+    pgs = [_pg_row("holder-a", 0, "CREATED", 0.0),
+           _pg_row("starved-vip", 9, "PENDING", now - 90.0)]
+    out = find_starved_jobs(pgs, now, warn_s=60.0)
+    assert out[0]["severity"] == "critical"
+    assert "outranks" in out[0]["detail"]
+
+
+def test_find_starved_jobs_quota_probe_and_quiet_cases():
+    from ray_tpu.util.doctor import find_starved_jobs
+
+    now = 1000.0
+    # Over-quota starvation suggests a quota bump, not preemption.
+    out = find_starved_jobs(
+        [_pg_row("capped", 0, "PENDING", now - 70.0,
+                 reason="over_quota")], now, warn_s=60.0)
+    assert "quota" in out[0]["probe"]
+    # Young pends and CREATED groups yield nothing.
+    assert not find_starved_jobs(
+        [_pg_row("young", 0, "PENDING", now - 5.0),
+         _pg_row("done", 0, "CREATED", 0.0)], now, warn_s=60.0)
+
+
+# ----------------------------------------------------- goodput attribution
+def test_goodput_summarize_sources_per_job_breakdown():
+    from ray_tpu.util import goodput
+
+    def snap(job, compute):
+        series = [{"tags": {"phase": "compute", "job": job},
+                   "value": compute}]
+        return [{"name": goodput.GAUGE_NAME, "kind": "gauge",
+                 "series": series}]
+
+    summary = goodput.summarize_sources({
+        "w-1": snap("train-hi", 6.0),
+        "w-2": snap("train-hi", 3.0),
+        "w-3": snap("serve-lo", 1.0),
+        # Untagged legacy series still aggregate cluster-wide.
+        "w-4": [{"name": goodput.GAUGE_NAME, "kind": "gauge",
+                 "series": [{"tags": {"phase": "compute"},
+                             "value": 2.0}]}]})
+    assert summary["seconds"]["compute"] == pytest.approx(12.0)
+    assert summary["per_job"]["train-hi"]["compute"] == \
+        pytest.approx(9.0)
+    assert summary["per_job"]["serve-lo"]["compute"] == \
+        pytest.approx(1.0)
+    assert set(summary["per_job"]) == {"train-hi", "serve-lo"}
+
+
+def test_goodput_set_job_id_tags_published_series():
+    from ray_tpu.util import goodput
+    from ray_tpu.util.metrics import registry
+
+    registry().clear()
+    goodput.reset()
+    goodput.set_job_id("tag-test-job")
+    try:
+        with goodput.ledger().phase("compute"):
+            pass
+        snaps = {s["name"]: s for s in registry().snapshot()}
+        tags = [s["tags"] for s in
+                snaps[goodput.GAUGE_NAME]["series"]]
+        assert all(t.get("job") == "tag-test-job" for t in tags)
+    finally:
+        goodput.set_job_id("")
+        registry().clear()
+        goodput.reset()
+
+
+# ------------------------------------------------- telemetry spill counters
+def test_telemetry_surfaces_object_spill_counters(monkeypatch):
+    from ray_tpu.util import state as state_api
+    from ray_tpu.util import telemetry as telemetry_mod
+
+    sources = {
+        "node-aa": [
+            {"name": "rt_object_spilled_bytes", "kind": "gauge",
+             "series": [{"tags": {}, "value": 4096.0}]},
+            {"name": "rt_object_spill_total", "kind": "counter",
+             "series": [{"tags": {}, "value": 3.0}]},
+            {"name": "rt_object_restore_total", "kind": "counter",
+             "series": [{"tags": {}, "value": 2.0}]}],
+        "node-bb": [
+            {"name": "rt_object_spill_total", "kind": "counter",
+             "series": [{"tags": {}, "value": 1.0}]}],
+    }
+    monkeypatch.setattr(state_api, "telemetry",
+                        lambda address=None: {"ts": 1.0,
+                                              "sources": sources,
+                                              "flight": []})
+    monkeypatch.setattr(state_api, "metrics_history",
+                        lambda address=None: {})
+    summary = telemetry_mod.cluster_summary()
+    assert summary["object_store"] == {"spilled_bytes": 4096.0,
+                                       "spill_total": 4.0,
+                                       "restore_total": 2.0}
+    text = telemetry_mod.render_text(summary)
+    assert "Object store:" in text
+    assert "spills total  4" in text

@@ -1,0 +1,357 @@
+"""Chaos acceptance (ISSUE 6): train + serve + data jobs coexisting on
+one OVERSUBSCRIBED two-worker cluster, under node churn.
+
+The scenario:
+
+  - a low-priority training job (``train-lo``, elastic 2->1, STRICT_SPREAD
+    2x2 CPU, ``max_failures=0``) fills the cluster,
+  - a quota-capped data job and a small serve job ride along,
+  - a HIGH-priority training gang (``train-hi``, priority 10) is
+    submitted into the full cluster: it cannot place, so the admission
+    loop selects ``train-lo`` as the victim and preempts it through the
+    drain/checkpoint-on-notice path,
+  - a PreemptionKiller SIGTERM->SIGKILLs a sacrificial node mid-run
+    (control-plane churn on top of the tenant scenario),
+  - the high-priority job finishes first; the preempted trainer resumes
+    FROM ITS NOTICE CHECKPOINT with ``max_failures`` intact (the loss
+    was announced, so it burned no budget) and completes,
+  - `rt jobs` lists every job with priority/quota/state, `rt telemetry`
+    attributes goodput per job, and `rt doctor` exits 0 once settled.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from types import SimpleNamespace
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.job import JobSubmissionClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ENV = {
+    "RT_METRICS_REPORT_PERIOD_S": "0.5",
+    "RT_RAYLET_HEARTBEAT_PERIOD_MS": "300",
+    "RT_PREEMPTION_GRACE_S": "4",
+    "RT_PREEMPT_PENDING_S": "0.5",
+    "RT_RESTART_BACKOFF_BASE_S": "0.3",
+    "RT_RESTART_BACKOFF_MAX_S": "1.0",
+    "RT_RESTART_BACKOFF_JITTER": "0.25",
+}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    old = {k: os.environ.get(k) for k in _ENV}
+    os.environ.update(_ENV)
+    c = Cluster(head_node_args={"num_cpus": 3})
+    c.add_node(num_cpus=2)
+    # The chaos sacrifice: no schedulable CPU, so the killer's churn
+    # exercises drain/death/doctor paths without eating tenant jobs.
+    c.add_node(num_cpus=0, resources={"chaos": 1})
+    ray_tpu.init(address=c.address)
+    c.wait_for_nodes()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _rt(*args, timeout=90):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", *args],
+        capture_output=True, text=True, env=env, timeout=timeout)
+
+
+def _wait(pred, timeout=60, what="condition", poll=0.25):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(poll)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+_TRAIN_SCRIPT = """\
+import json, sys, time
+sys.path.insert(0, {repo!r})
+import ray_tpu
+ray_tpu.init(address={addr!r})
+from ray_tpu.train import (ElasticScalingPolicy, FailurePolicy,
+                           RunConfig, ScalingConfig, TrainControllerV2)
+from ray_tpu.train.v2 import FixedScalingPolicy
+from ray_tpu.train.backend import Backend
+from ray_tpu.train.trainer import BaseTrainer
+
+
+def loop(config):
+    import time as _t
+    from ray_tpu import train
+    from ray_tpu.train import Checkpoint
+
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        start = ckpt.load_json("meta")["step"]
+    saved_notice = False
+    for step in range(start, config["steps"]):
+        _t.sleep(0.2)
+        if train.get_world_rank() != 0:
+            train.report({{"step": step, "start": start}})
+            continue
+        if train.interrupted() and not saved_notice:
+            saved_notice = True
+            with train.checkpoint_on_notice():
+                with train.checkpoint_dir() as d:
+                    c = Checkpoint(d)
+                    c.save_json("meta", {{"step": step}})
+                    train.report({{"step": step, "start": start,
+                                   "notice": True}}, checkpoint=c)
+        elif step == 1:
+            with train.checkpoint_dir() as d:
+                c = Checkpoint(d)
+                c.save_json("meta", {{"step": step}})
+                train.report({{"step": step, "start": start}},
+                             checkpoint=c)
+        else:
+            train.report({{"step": step, "start": start}})
+        with open(config["progress"], "w") as f:
+            f.write(str(step))
+    return start
+
+
+trainer = BaseTrainer(
+    loop,
+    train_loop_config={{"steps": {steps}, "progress": {progress!r}}},
+    scaling_config=ScalingConfig(num_workers=2,
+                                 resources_per_worker={{"CPU": 2.0}},
+                                 placement_strategy="STRICT_SPREAD"),
+    run_config=RunConfig(name={name!r}, storage_path={storage!r}))
+trainer.backend_cls = Backend
+# The preemptor demands its FULL fixed gang (a shrunk elastic gang
+# would skip the placement group and never contend); the victim stays
+# elastic so it can resume on whatever capacity frees first.
+policy = (FixedScalingPolicy(2) if {fixed}
+          else ElasticScalingPolicy(min_workers=2, max_workers=2,
+                                    resources_per_worker={{"CPU": 2.0}}))
+controller = TrainControllerV2(
+    trainer, scaling_policy=policy,
+    failure_policy=FailurePolicy(max_failures=0))
+out = {{"error": None}}
+try:
+    result = controller.fit()
+    out["error"] = repr(result.error) if result.error else None
+    out["starts"] = sorted({{h["metrics"]["start"]
+                             for h in result.metrics_history}})
+    out["notice_steps"] = [h["metrics"]["step"]
+                           for h in result.metrics_history
+                           if h["metrics"].get("notice")]
+    out["preempt_ckpt"] = [bool(h.get("preempt_ckpt"))
+                           for h in result.metrics_history
+                           if h["metrics"].get("notice")]
+    out["max_step"] = max(h["metrics"]["step"]
+                          for h in result.metrics_history)
+except Exception as e:  # noqa: BLE001 — the test reads this file
+    out["error"] = repr(e)
+out["announced"] = controller.announced_failures
+out["attempt_sizes"] = controller.attempt_sizes
+out["backoff_delays"] = controller.backoff_delays
+with open({results!r}, "w") as f:
+    json.dump(out, f)
+sys.exit(1 if out["error"] else 0)
+"""
+
+_DATA_SCRIPT = """\
+import sys, time
+sys.path.insert(0, {repo!r})
+import ray_tpu
+ray_tpu.init(address={addr!r})
+
+@ray_tpu.remote(num_cpus=0.25)
+def chew(i):
+    import time as _t
+    _t.sleep(1.0)
+    return i
+
+done = 0
+pending = [chew.remote(i) for i in range(2)]
+while done < {rounds}:
+    ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=30)
+    for r in ready:
+        ray_tpu.get(r)
+        done += 1
+        pending.append(chew.remote(done))
+with open({marker!r}, "w") as f:
+    f.write(str(done))
+print("DATA_DONE", done)
+"""
+
+_SERVE_SCRIPT = """\
+import sys, time
+sys.path.insert(0, {repo!r})
+import ray_tpu
+ray_tpu.init(address={addr!r})
+
+@ray_tpu.remote(num_cpus=0.25)
+class Echo:
+    def ping(self, i):
+        import time as _t
+        _t.sleep(0.05)
+        return i
+
+a = Echo.remote()
+for i in range(20):
+    assert ray_tpu.get(a.ping.remote(i), timeout=60) == i
+with open({marker!r}, "w") as f:
+    f.write("ok")
+print("SERVE_DONE")
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_priority_preemption_on_oversubscribed_cluster(cluster,
+                                                       tmp_path):
+    from ray_tpu.testing.chaos import PreemptionKiller
+
+    client = JobSubmissionClient(cluster.address)
+    progress = str(tmp_path / "lo_progress")
+    lo_results = str(tmp_path / "lo_results.json")
+    hi_results = str(tmp_path / "hi_results.json")
+    data_marker = str(tmp_path / "data_done")
+    serve_marker = str(tmp_path / "serve_done")
+
+    def _submit(job_id, script, priority=0, quota=None):
+        path = tmp_path / f"{job_id}.py"
+        path.write_text(script)
+        return client.submit_job(
+            entrypoint=f"{sys.executable} -u {path}",
+            submission_id=job_id, priority=priority, quota=quota)
+
+    # 1. The low-priority trainer fills the cluster (2x2 CPU across
+    #    head(3) + worker(2)).
+    _submit("train-lo", _TRAIN_SCRIPT.format(
+        repo=REPO, addr=cluster.address, steps=150, progress=progress,
+        name="lo", storage=str(tmp_path / "lo"), results=lo_results,
+        fixed=False))
+    _wait(lambda: os.path.exists(progress)
+          and int(open(progress).read() or 0) >= 3,
+          timeout=120, what="low-priority training progress")
+
+    # 2. Chaos: a preemption wave takes out the sacrificial node while
+    #    the tenant scenario runs (the shim spares everything else).
+    killer = PreemptionKiller(
+        SimpleNamespace(nodes=[cluster.nodes[0], cluster.nodes[2]]),
+        interval_s=6.0, grace_s=2.0, max_kills=1).start()
+
+    # 3. Bystander tenants: a quota-capped data job + a serve job.
+    _submit("data-lo", _DATA_SCRIPT.format(
+        repo=REPO, addr=cluster.address, rounds=25, marker=data_marker),
+        quota={"CPU": 1.0})
+    _submit("serve-lo", _SERVE_SCRIPT.format(
+        repo=REPO, addr=cluster.address, marker=serve_marker))
+
+    # 4. The high-priority gang lands in a FULL cluster.
+    _submit("train-hi", _TRAIN_SCRIPT.format(
+        repo=REPO, addr=cluster.address, steps=6,
+        progress=str(tmp_path / "hi_progress"), name="hi",
+        storage=str(tmp_path / "hi"), results=hi_results, fixed=True),
+        priority=10)
+
+    # The victim must observe a preemption notice (PREEMPTING shows on
+    # its rt jobs row while the grace window runs).
+    quota_samples = []
+
+    def _saw_preempting():
+        r = _rt("jobs", "--format", "json",
+                "--address", cluster.address, timeout=30)
+        rows = {j["job_id"]: j for j in json.loads(r.stdout or "[]")}
+        data_row = rows.get("data-lo")
+        if data_row and data_row.get("state") == "RUNNING":
+            quota_samples.append(
+                (data_row.get("usage") or {}).get("CPU", 0.0))
+        lo = rows.get("train-lo")
+        return lo and (lo.get("preempting")
+                       or lo.get("state") in ("SUCCEEDED", "FAILED"))
+
+    _wait(_saw_preempting, timeout=60, what="train-lo preemption notice")
+
+    # 5. The high-priority job wins: it finishes first and cleanly.
+    st = client.wait_until_finished("train-hi", timeout=180)
+    assert st.status == "SUCCEEDED", (st.status, st.message,
+                                      client.get_job_logs("train-hi"))
+    hi = json.load(open(hi_results))
+    assert hi["error"] is None, hi
+    assert hi["max_step"] == 5
+
+    # 6. The preempted trainer resumes from its NOTICE checkpoint and
+    #    completes with max_failures (=0) intact.
+    st = client.wait_until_finished("train-lo", timeout=300)
+    assert st.status == "SUCCEEDED", (st.status, st.message,
+                                      client.get_job_logs("train-lo"))
+    lo = json.load(open(lo_results))
+    assert lo["error"] is None, lo
+    assert lo["announced"] >= 1, lo          # loss was ANNOUNCED
+    assert lo["backoff_delays"], lo          # re-queued behind backoff
+    assert lo["notice_steps"], "no checkpoint-on-notice reported"
+    assert all(lo["preempt_ckpt"]), lo       # urgent save, attributed
+    notice_step = lo["notice_steps"][0]
+    assert notice_step >= 2
+    # Resume came from THE notice checkpoint, not the step-1 periodic.
+    assert lo["starts"] == [0, notice_step], lo
+    assert lo["max_step"] == 149
+
+    # 7. Bystanders survived the oversubscription and the node kill.
+    st = client.wait_until_finished("data-lo", timeout=120)
+    assert st.status == "SUCCEEDED", client.get_job_logs("data-lo")
+    st = client.wait_until_finished("serve-lo", timeout=120)
+    assert st.status == "SUCCEEDED", client.get_job_logs("serve-lo")
+    killer.stop()
+    assert killer.kills, "the chaos killer never fired"
+    # Quota held while sampled: the capped data job never ran far over
+    # its 1-CPU cap (one 0.25-CPU task of heartbeat-lag slack).
+    assert all(v <= 1.26 for v in quota_samples), quota_samples
+
+    # 8. `rt jobs` answers "who is paying": every job, with priority/
+    #    quota/state.
+    r = _rt("jobs", "--format", "json", "--address", cluster.address)
+    rows = {j["job_id"]: j for j in json.loads(r.stdout)}
+    assert {"train-lo", "train-hi", "data-lo",
+            "serve-lo"} <= set(rows)
+    assert rows["train-hi"]["priority"] == 10
+    assert rows["train-lo"]["priority"] == 0
+    assert rows["data-lo"]["quota"] == {"CPU": 1.0}
+    assert all(rows[j]["state"] == "SUCCEEDED" for j in rows)
+    table = _rt("jobs", "--address", cluster.address)
+    assert "train-hi" in table.stdout and "pri" in table.stdout
+
+    # 9. Per-job goodput attribution flows through rt telemetry.
+    r = _rt("telemetry", "--format", "json",
+            "--address", cluster.address)
+    per_job = json.loads(r.stdout)["goodput"].get("per_job") or {}
+    assert "train-lo" in per_job, per_job.keys()
+    assert sum(per_job["train-lo"].values()) > 0
+
+    # 10. No lease/PG deadlock left behind: once the dust settles the
+    #     doctor exits 0 (no critical findings).
+    def _doctor_ok():
+        r = _rt("doctor", "--format", "json",
+                "--address", cluster.address, timeout=60)
+        return r if r.returncode == 0 else None
+
+    r = _wait(_doctor_ok, timeout=90, what="rt doctor exit 0")
+    diag = json.loads(r.stdout)
+    assert not any(f["severity"] == "critical"
+                   for f in diag.get("findings", [])), diag
