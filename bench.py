@@ -340,7 +340,74 @@ def long_context() -> None:
     _maybe_record(out)
 
 
-def _maybe_record(out: dict, extra_rows: list = None) -> None:
+def cold_start() -> None:
+    """--cold-start: 100-replica serve deployment cold start through
+    the control-plane fast path — the warm-worker prestart pool is
+    filled FIRST, then the wall time from ``serve.run`` to every
+    replica answering is measured.  Reports the adoption vs cold-spawn
+    delta alongside (a nonzero fallback count means the pool was
+    outrun and some replicas paid a full interpreter spawn).
+    """
+    import os
+    import sys
+
+    n_replicas = 10 if "--quick" in sys.argv else 100
+    # Pool sizing must precede init so the agent's config carries it
+    # (+ headroom for the serve controller/proxy actors).
+    os.environ.setdefault("RT_WORKER_PRESTART", str(n_replicas + 8))
+    os.environ.setdefault("RT_WORKER_POOL_MAX_WORKERS",
+                          str(n_replicas + 64))
+    os.environ.setdefault("RT_WORKER_PRESTART_BURST", "16")
+    os.environ.setdefault("RT_ACTOR_READY_TIMEOUT_S", "600")
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.util.scale_bench import _pool_totals, wait_pool_fill
+
+    ray_tpu.init(mode="cluster", num_cpus=4)
+    try:
+        filled = wait_pool_fill(n_replicas + 4, timeout=600.0)
+        print(f"prestart pool warm: {filled} idle worker(s)",
+              flush=True)
+        before = _pool_totals()
+
+        @serve.deployment(num_replicas=n_replicas, name="cold",
+                          ray_actor_options={"num_cpus": 0})
+        def noop(_req=None):
+            return "ok"
+
+        t0 = time.perf_counter()
+        serve.run(noop.bind(), route_prefix="/cold")
+        # "Cold start" ends when every replica process answers — poll
+        # each replica actor directly (the handle would be satisfied
+        # by the first few live replicas).
+        ctl = ray_tpu.get_actor(serve.CONTROLLER_NAME)
+        replicas = ray_tpu.get(ctl.get_replicas.remote("cold"),
+                               timeout=120)
+        ray_tpu.get([r.ongoing.remote() for r in replicas],
+                    timeout=600)
+        dt = time.perf_counter() - t0
+        after = _pool_totals()
+        adopted = int(after["adoptions"] - before["adoptions"])
+        cold = int(after["cold_spawns"] - before["cold_spawns"])
+        out = {
+            "metric": f"serve_cold_start_{n_replicas}_replicas_s",
+            "value": round(dt, 3), "unit": "s",
+            "extra": {"replicas": len(replicas), "adopted": adopted,
+                      "cold_spawn_fallbacks": cold},
+        }
+        print(json.dumps(out))
+        if len(replicas) != n_replicas:
+            raise RuntimeError(
+                f"cold start brought up {len(replicas)} of "
+                f"{n_replicas} replicas")
+        _maybe_record(out, higher_is_better=False)
+    finally:
+        ray_tpu.shutdown()
+
+
+def _maybe_record(out: dict, extra_rows: list = None,
+                  higher_is_better: bool = True) -> None:
     """--record: append to the PERF.jsonl round-over-round regression
     ledger (tests/test_perf_ledger.py guards >20% drops)."""
     import sys
@@ -351,7 +418,9 @@ def _maybe_record(out: dict, extra_rows: list = None) -> None:
 
     perf_ledger.record(
         [{"benchmark": out["metric"], "value": out["value"],
-          "unit": out["unit"]}] + list(extra_rows or []),
+          "unit": out["unit"],
+          "higher_is_better": higher_is_better}]
+        + list(extra_rows or []),
         source="bench")
 
 
@@ -362,5 +431,7 @@ if __name__ == "__main__":
         long_context()
     elif "--data-pipeline" in sys.argv:
         data_pipeline()
+    elif "--cold-start" in sys.argv:
+        cold_start()
     else:
         main()

@@ -13,7 +13,6 @@ import functools
 import inspect
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
-import cloudpickle
 
 from . import runtime as _runtime_mod
 from .ids import ActorID

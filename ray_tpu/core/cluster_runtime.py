@@ -199,6 +199,19 @@ class ClusterRuntime(BaseRuntime):
         self._agent: Optional[RpcClient] = None
         self._worker_clients: Dict[str, RpcClient] = {}
         self._actor_cache: Dict[ActorID, Dict] = {}
+        # Batched actor registration: unnamed actor registrations
+        # coalesce on a 5 ms window into one bulk register_actors RPC
+        # (a 100-replica fan-out = a handful of controller round
+        # trips).  Io-loop state only.
+        self._actor_reg_buf: List = []
+        self._actor_reg_flusher = None
+        # Actors whose batched registration has not committed at the
+        # controller yet: the submit path must wait these out before
+        # polling get_actor, or a fast first call would read "unknown
+        # actor" in the 5 ms window.  Marked on the caller's thread in
+        # create_actor (program order guarantees the mark exists
+        # before any call on the handle), cleared on the io loop.
+        self._actor_reg_pending: Dict[ActorID, bool] = {}
         self._pending_returns: Set[ObjectID] = set()
         self._submissions: Dict[ObjectID, _Submission] = {}
         self._completion_events: Dict[ObjectID, asyncio.Event] = {}
@@ -1865,27 +1878,109 @@ class ClusterRuntime(BaseRuntime):
 
     # ------------------------------------------------------------- actors
     def create_actor(self, spec: TaskSpec) -> None:
-        r = self.io.run(self._ctl.call("register_actor", {
+        payload = {
             "spec": spec, "class_name": spec.name.split(".")[0],
             "method_names": spec.method_names,
             "detached": spec.lifetime == "detached",
-            "owner_addr": self._runtime_id}))
-        if not r.get("ok"):
-            raise ValueError(r.get("error", "actor registration failed"))
+            "owner_addr": self._runtime_id}
+        if spec.actor_name:
+            # Named actors keep the synchronous path: the name-conflict
+            # refusal must raise HERE, in the caller's frame.
+            r = self.io.run(self._ctl.call("register_actor", payload))
+            if not r.get("ok"):
+                raise ValueError(
+                    r.get("error", "actor registration failed"))
+            payload = None  # already registered
+        else:
+            self._actor_reg_pending[spec.actor_id] = True
         held = [a.object_id for a in spec.args
                 if a.kind == ArgKind.OBJECT_REF and a.object_id is not None]
         self._add_submitted_holds(held)
         self.io.call_soon(lambda: self.io.loop.create_task(
-            self._create_actor_async(spec, held)))
+            self._create_actor_async(spec, held, payload)))
 
     async def _create_actor_async(self, spec: TaskSpec,
                                   held: Optional[List[ObjectID]]
+                                  = None,
+                                  reg_payload: Optional[Dict]
                                   = None) -> None:
         try:
+            if reg_payload is not None:
+                # Unnamed actor: registration rides the coalescing
+                # batch (it cannot hit a name conflict, so deferring
+                # the result off the caller's thread loses nothing).
+                try:
+                    r = await self._register_actor_batched(reg_payload)
+                except (RpcError, RemoteCallError) as e:
+                    r = {"ok": False, "error": repr(e)}
+                finally:
+                    self._actor_reg_pending.pop(spec.actor_id, None)
+                if not r.get("ok"):
+                    # The controller never learned this actor exists,
+                    # so callers polling get_actor would only see an
+                    # opaque "unknown actor" after the full grace.
+                    # Leave a LOCAL terminal cache entry instead: the
+                    # first method call fails fast with the real
+                    # registration error (death_reason set marks it
+                    # as locally authoritative — controller-mirrored
+                    # DEAD entries from the event poll carry none).
+                    reason = (f"actor registration failed: "
+                              f"{r.get('error', 'unknown error')}")
+                    logger.warning("actor %s: %s",
+                                   spec.actor_id.hex()[:8], reason)
+                    self._actor_cache[spec.actor_id] = {
+                        "actor_id": spec.actor_id, "state": "DEAD",
+                        "worker_addr": "", "death_reason": reason,
+                        "class_name": spec.name.split(".")[0],
+                        "method_names": spec.method_names,
+                        "max_concurrency": spec.max_concurrency,
+                        "concurrency_groups": {},
+                        "method_options": {}}
+                    return
             await self._create_actor_inner(spec)
         finally:
+            self._actor_reg_pending.pop(spec.actor_id, None)
             if held:
                 self._release_submitted_holds(held)
+
+    async def _register_actor_batched(self, payload: Dict) -> Dict:
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._actor_reg_buf.append((payload, fut))
+        if self._actor_reg_flusher is None or \
+                self._actor_reg_flusher.done():
+            from .rpc import spawn_task
+
+            self._actor_reg_flusher = spawn_task(
+                self._flush_actor_regs())
+        return await fut
+
+    async def _flush_actor_regs(self) -> None:
+        """Drain the registration buffer in bulk register_actors RPCs;
+        the 5 ms sleep IS the coalescing window (everything enqueued
+        while a flush's RPC is in flight batches into the next)."""
+        while self._actor_reg_buf:
+            await asyncio.sleep(0.005)
+            items, self._actor_reg_buf = self._actor_reg_buf, []
+            if not items:
+                continue
+            try:
+                r = await self._ctl.call(
+                    "register_actors",
+                    {"items": [p for p, _f in items]})
+                results = r.get("results") or []
+            except (RpcError, RemoteCallError) as e:
+                for _p, fut in items:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            for (_p, fut), res in zip(items, results):
+                if not fut.done():
+                    fut.set_result(res if res is not None
+                                   else {"ok": False})
+            for _p, fut in items[len(results):]:
+                if not fut.done():
+                    fut.set_result({"ok": False,
+                                    "error": "short bulk reply"})
 
     async def _create_actor_inner(self, spec: TaskSpec) -> None:
         """Creation-path fault tolerance (ref: gcs_actor_manager.h:90
@@ -1970,10 +2065,24 @@ class ClusterRuntime(BaseRuntime):
             r = await worker.call("create_actor", {
                 "spec": spec, "chip_ids": grant.get("chip_ids", []),
                 "lease_id": grant["lease_id"]})
-            if not r.get("ok"):
-                # Worker reported the creation error to the controller
-                # already; nothing else to do owner-side.
-                pass
+            if r.get("ok"):
+                # The worker's reply means actor_started committed at
+                # the controller, so the first method call can skip
+                # the get_actor poll entirely — prime the cache with
+                # the fields _actor_info consumers read.  A later
+                # death still invalidates it (the pubsub actor-event
+                # hook and the submit paths pop dead entries).
+                self._actor_cache[spec.actor_id] = {
+                    "actor_id": spec.actor_id, "state": "ALIVE",
+                    "worker_addr": grant["worker_addr"],
+                    "class_name": spec.name.split(".")[0],
+                    "method_names": spec.method_names,
+                    "death_reason": "",
+                    "max_concurrency": spec.max_concurrency,
+                    "concurrency_groups": dict(
+                        getattr(spec, "concurrency_groups", {}) or {}),
+                    "method_options": dict(
+                        getattr(spec, "method_options", {}) or {})}
         except RpcError:
             raise  # infra failure: _create_actor_inner retries
         except (RemoteCallError, ValueError):
@@ -2012,9 +2121,28 @@ class ClusterRuntime(BaseRuntime):
         if timeout is None:
             timeout = self.config.actor_ready_timeout_s
         deadline = asyncio.get_event_loop().time() + timeout
+        # A batched registration still in flight means get_actor would
+        # read "unknown actor" spuriously — wait the 5 ms window out
+        # (bounded by the ready deadline like every other wait here).
+        while actor_id in self._actor_reg_pending and \
+                asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.002)
+        # A handle can also cross PROCESSES inside the creator's
+        # batching window (serve controller -> driver): grant unknown
+        # actors a short grace before declaring them dead, so the
+        # remote registration flush can land.
+        unknown_grace = asyncio.get_event_loop().time() + \
+            min(5.0, timeout)
         delay = 0.02
         while True:
             info = self._actor_cache.get(actor_id)
+            if info is not None and info["state"] == "DEAD" and \
+                    info.get("death_reason"):
+                # Locally-authoritative terminal entry (e.g. the
+                # batched registration failed, so the controller has
+                # no record to poll): fail fast with the real reason.
+                raise ActorDiedError(actor_id.hex(),
+                                     info["death_reason"])
             if info is None or info["state"] not in ("ALIVE",) or \
                     not info.get("worker_addr"):
                 info = await self._ctl.call("get_actor",
@@ -2022,6 +2150,11 @@ class ClusterRuntime(BaseRuntime):
                 if info is not None:
                     self._actor_cache[actor_id] = info
             if info is None:
+                if wait_alive and \
+                        asyncio.get_event_loop().time() < unknown_grace:
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 1.5, 0.5)
+                    continue
                 raise ActorDiedError(actor_id.hex(), "unknown actor")
             if info["state"] == "ALIVE" and info.get("worker_addr"):
                 return info
@@ -2195,8 +2328,21 @@ class ClusterRuntime(BaseRuntime):
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
         self._actor_cache.pop(actor_id, None)
-        self.io.run(self._ctl.call("kill_actor", {
-            "actor_id": actor_id, "no_restart": no_restart}))
+
+        async def _kill():
+            # A kill racing this owner's own batched registration
+            # would reach the controller BEFORE the actor exists and
+            # be silently ignored — the actor would then start and
+            # run forever.  Wait the coalescing window out (bounded),
+            # like _actor_info does for method calls.
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while actor_id in self._actor_reg_pending and \
+                    asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.002)
+            return await self._ctl.call("kill_actor", {
+                "actor_id": actor_id, "no_restart": no_restart})
+
+        self.io.run(_kill())
 
     def get_named_actor(self, name: str, namespace: str = ""):
         info = self.io.run(self._ctl.call("lookup_named_actor", {

@@ -71,6 +71,25 @@ define_flag("worker_pool_min_workers", int, 0,
             "Pre-started idle workers per node.")
 define_flag("worker_pool_max_workers", int, 0,
             "Max concurrent workers per node (0 = #CPUs).")
+define_flag("worker_prestart", int, -1,
+            "Warm-worker prestart pool target per node: the agent "
+            "keeps this many idle workers pre-spawned (per runtime-"
+            "env hash) so actor/task creation ADOPTS a live process "
+            "instead of paying a full interpreter spawn (ref: "
+            "worker_pool.h:216 PopWorker).  -1 = node CPU count; "
+            "0 disables prestarting.")
+define_flag("worker_prestart_refill_ms", int, 200,
+            "Prestart pool refill cadence: the pool is also refilled "
+            "immediately after every adoption; this periodic tick "
+            "heals losses (worker death, env churn).")
+define_flag("worker_prestart_burst", int, 0,
+            "Spawn-storm hysteresis: max worker processes concurrently "
+            "forked-but-unregistered by the prestart refill (bounds "
+            "the fork herd on small hosts).  0 = max(2, node CPUs).")
+define_flag("worker_prestart_env_ttl_s", float, 60.0,
+            "How long a non-default runtime-env hash stays warm (the "
+            "pool keeps prestarted workers for env hashes adopted "
+            "within this window; the default env is always warm).")
 define_flag("worker_idle_timeout_s", float, 60.0,
             "Idle worker reap timeout.")
 define_flag("worker_start_timeout_s", float, 60.0,

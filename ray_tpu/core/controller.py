@@ -66,6 +66,10 @@ class NodeEntry:
     drain_deadline: float = 0.0
     drain_reason: str = ""
     drain_replace: bool = True
+    # Prestart-pool occupancy mirrored from the agent heartbeat
+    # ({idle, target, adoptions, cold_spawns}) for `rt status` and
+    # the dashboard node table.
+    worker_pool: Dict = field(default_factory=dict)
 
 
 @dataclass
@@ -157,7 +161,8 @@ class Controller:
         self._shutdown = asyncio.Event()
         for name in [
             "register_node", "heartbeat", "list_nodes", "resource_view",
-            "register_actor", "actor_started", "actor_died", "get_actor",
+            "register_actor", "register_actors", "actor_started",
+            "actors_started", "actor_died", "get_actor",
             "lookup_named_actor", "kill_actor", "worker_exited",
             "kv_put", "kv_get", "kv_del", "kv_keys", "kv_append", "kv_list",
             "publish_locations", "remove_locations", "update_locations",
@@ -239,12 +244,25 @@ class Controller:
         node = self.nodes.get(p["node_id"])
         if node is None:
             return {"ok": False, "reregister": True}
+        if not node.alive:
+            # The health loop declared this node dead (missed
+            # heartbeats — e.g. its event loop starved under a worker
+            # fork storm), but the agent is clearly still with us.
+            # Without this, a transiently-stalled agent is a PERMANENT
+            # zombie: it keeps heartbeating into a row nothing ever
+            # resurrects, invisible to scheduling forever.  Route it
+            # through the same re-register protocol a restarted
+            # controller uses — register_node rebuilds the row alive
+            # and the agent republishes its object locations.
+            return {"ok": False, "reregister": True}
         node.last_heartbeat = time.time()
         node.resources_available = p.get("available", node.resources_available)
         if "total" in p:
             node.resources_total = p["total"]
         node.idle_s = p.get("idle_s", 0.0)
         node.pending_demands = p.get("pending_demands", [])
+        if "worker_pool" in p:
+            node.worker_pool = p["worker_pool"] or {}
         if p.get("draining"):
             # The agent's own view is authoritative once it drains;
             # a heartbeat that predates a drain_node RPC must NOT
@@ -319,7 +337,8 @@ class Controller:
              "available": n.resources_available, "labels": n.labels,
              "is_head": n.is_head, "draining": n.draining,
              "drain_deadline": n.drain_deadline,
-             "drain_reason": n.drain_reason}
+             "drain_reason": n.drain_reason,
+             "worker_pool": dict(n.worker_pool)}
             for n in self.nodes.values()
         ]
 
@@ -492,6 +511,20 @@ class Controller:
         self._mark_dirty()
         return {"ok": True}
 
+    async def register_actors(self, p):
+        """Bulk actor registration (owner-side 5 ms coalescing window):
+        a 100-actor fan-out costs a handful of controller round trips
+        instead of one per actor.  Per-item results keep the single-
+        registration semantics (incl. name-conflict refusal)."""
+        return {"results": [await self.register_actor(item)
+                            for item in p.get("items") or []]}
+
+    async def actors_started(self, p):
+        """Bulk actor-started hellos (agent-side coalescing relay) —
+        the fan-in half of the fast path register_actors opens."""
+        return {"results": [await self.actor_started(item)
+                            for item in p.get("items") or []]}
+
     async def actor_started(self, p):
         actor = self.actors.get(p["actor_id"])
         if actor is None:
@@ -615,11 +648,30 @@ class Controller:
         if actor.node_id is not None:
             cli = await self._agent(actor.node_id)
             if cli is not None:
-                try:
-                    await cli.call("kill_worker",
-                                   {"actor_id": actor.actor_id})
-                except RpcError:
-                    pass
+                aid = actor.actor_id
+
+                async def _kill():
+                    try:
+                        await cli.call("kill_worker", {"actor_id": aid})
+                    except RpcError:
+                        pass
+
+                if p.get("no_restart", True):
+                    # Off the reply path: a fleet teardown issues
+                    # hundreds of kills, and each agent round trip
+                    # serialized into the caller's kill() call
+                    # dominates teardown time.  Safe only because the
+                    # actor id is terminal here — nothing rebinds it.
+                    # The SIGKILL itself is asynchronous either way
+                    # (death is observed by the agent's reap loop).
+                    spawn_task(_kill())
+                else:
+                    # Restartable: the kill MUST land before the
+                    # restart path can bind a fresh worker to the same
+                    # actor id, or the late SIGKILL (resolved by
+                    # actor_id agent-side) takes down the new
+                    # incarnation.
+                    await _kill()
         await self._handle_actor_failure(actor, "killed via kill()",
                                          no_restart=p.get("no_restart", True))
         return {"ok": True}

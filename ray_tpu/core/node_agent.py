@@ -35,9 +35,49 @@ from .config import RuntimeConfig
 from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, WorkerID
 from .object_store import SharedObjectStore, StoreDirectory
 from .resources import ResourceSet, node_resources
-from .rpc import RpcClient, RpcError, RpcServer, spawn_task
+from .rpc import (RemoteCallError, RpcClient, RpcError, RpcServer,
+                  spawn_task)
 
 logger = logging.getLogger("ray_tpu.node_agent")
+
+
+def pool_plan(*, target: int, idle: int, starting: int, leased: int,
+              pending_spawns: int, burst: int, max_workers: int,
+              active: int, draining: bool = False) -> int:
+    """How many prestart workers to spawn THIS refill tick (pure —
+    unit-tested without an agent).
+
+    ``idle``/``starting``/``leased`` count non-actor workers of the env
+    hash being refilled: a leased task worker returns to the pool, so
+    it still satisfies the target, while an adopted actor worker never
+    does.  ``pending_spawns`` vs ``burst`` is the spawn-storm
+    hysteresis — at most ``burst`` forked-but-unregistered processes
+    exist at once, so a refill after a mass adoption trickles the herd
+    instead of forking it in one stampede.  A draining node never
+    refills (its pool is being killed, not warmed)."""
+    if draining or target <= 0:
+        return 0
+    deficit = target - idle - starting - leased
+    if deficit <= 0:
+        return 0
+    budget = burst - pending_spawns
+    room = max_workers - active
+    return max(0, min(deficit, budget, room))
+
+
+def warm_env_targets(now: float, default_target: int,
+                     env_last_used: Dict[str, float],
+                     ttl_s: float) -> Dict[str, int]:
+    """Which runtime-env hashes the prestart pool keeps warm: the
+    default env always, plus any hash adopted within ``ttl_s`` (each at
+    the full target — the reference pops workers by runtime-env hash,
+    worker_pool.h:216, so a hot non-default env deserves its own warm
+    set)."""
+    out = {"": default_target}
+    for env_hash, ts in env_last_used.items():
+        if env_hash and now - ts <= ttl_s:
+            out[env_hash] = default_target
+    return out
 
 
 @dataclass
@@ -57,6 +97,11 @@ class WorkerEntry:
     # ref: _private/log_monitor.py job tagging).
     log_path: str = ""
     job_id: Optional[str] = None
+    # True once this worker has served a lease and returned to the
+    # idle pool: a waiter handed a recycled worker paid NO fork, so
+    # the pool's cold-spawn (fork-latency) accounting must not count
+    # it (doctor's exhaustion check keys off that counter).
+    recycled: bool = False
 
 
 @dataclass
@@ -183,6 +228,35 @@ class NodeAgent:
         self._job_usage_reported: Dict[str, Dict[str, float]] = {}
         self._shutdown = asyncio.Event()
         self._spawned_procs: List[subprocess.Popen] = []
+        # Warm-worker prestart pool (ref: worker_pool.h:216 PopWorker /
+        # PrestartWorkers): idle workers pre-spawned per runtime-env
+        # hash so actor/task creation ADOPTS a live process instead of
+        # paying a full interpreter spawn.  Counters feed `rt
+        # telemetry`, `rt doctor` (pool exhaustion), and the scale
+        # benches' adoption-vs-cold-spawn report.
+        self._pool_adoptions = 0
+        self._pool_cold_spawns = 0
+        self._cold_spawn_ts: List[float] = []  # ring for the 60s window
+        self._spawned_total = 0
+        self._env_specs: Dict[str, Dict] = {}      # hash -> runtime_env
+        self._env_last_used: Dict[str, float] = {}
+        self._refill_wakeup = asyncio.Event()
+        # Worker startup-phase breakdown (spawn/import/connect stamped
+        # into the worker hello; adopt measured grant-side).
+        from ..util.metrics import Histogram
+
+        self._startup_hist = Histogram(
+            "rt_worker_startup_seconds",
+            "Worker startup time by phase (spawn=fork->interpreter, "
+            "import=module imports, connect=runtime connect+hello, "
+            "adopt=lease-grant wait for a worker).",
+            tag_keys=("phase",))
+        # Batched actor-started relay: workers report their actor hello
+        # here; the agent coalesces a creation fan-out into bulk
+        # controller RPCs on a short window (one persistent connection,
+        # a handful of frames — not one fresh dial per actor).
+        self._actor_started_buf: List[Tuple[Dict, asyncio.Future]] = []
+        self._actor_started_scheduled = False
         for name in [
             "request_lease", "return_lease", "lease_status",
             "cancel_lease_request", "list_leases", "report_lease_pool",
@@ -196,6 +270,7 @@ class NodeAgent:
             "object_exists", "objects_exist", "store_stats",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "restart_actor", "kill_worker", "report_actor_failure",
+            "report_actor_started", "pool_stats",
             "preempt_pg_leases",
             "drain", "shutdown", "ping", "node_info", "list_workers",
             "list_worker_logs", "read_worker_log", "profile_worker",
@@ -335,6 +410,7 @@ class NodeAgent:
             spawn_task(self._memory_monitor_loop())
         for _ in range(self.config.worker_pool_min_workers):
             self._spawn_worker()
+        spawn_task(self._prestart_refill_loop())
         return self.server.port
 
     async def _heartbeat_loop(self) -> None:
@@ -389,7 +465,18 @@ class NodeAgent:
                     # Multi-tenant accounting: plain-lease usage per
                     # internal job (PG-bound leases excluded — their
                     # bundles are counted controller-side).
-                    "job_usage": job_usage})
+                    "job_usage": job_usage,
+                    # Prestart-pool occupancy for `rt status` / the
+                    # dashboard node table.  Prestarted IDLE workers
+                    # deliberately do NOT touch _last_busy above:
+                    # a warm pool must never pin a node past its
+                    # idle timeout (the autoscaler's if_idle reap
+                    # and scale-down read idle_s).
+                    "worker_pool": {
+                        "idle": self._pool_counts("")[0],
+                        "target": self._prestart_target(),
+                        "adoptions": self._pool_adoptions,
+                        "cold_spawns": self._pool_cold_spawns}})
                 self._job_usage_reported = job_usage
                 self._job_view = r.get("jobs") or {}
                 now = time.time()
@@ -526,6 +613,7 @@ class NodeAgent:
         # re-evaluate their spawn budget or they sleep out their full
         # timeout while the pool sits empty.
         self._worker_ready.set()
+        self._kick_refill()
         if w.lease_id is not None and w.lease_id in self.leases:
             self._release_lease(self.leases[w.lease_id], worker_back=False)
         if prev_state == "actor" and w.actor_id is not None:
@@ -583,14 +671,18 @@ class NodeAgent:
             "RT_AGENT_ADDR": self.server.address,
             "RT_NODE_ID": self.node_id.hex(),
             "RT_OBJECT_STORE_BACKEND": self._store_backend,
+            # Startup-phase anchor: the worker stamps its hello with
+            # spawn/import/connect durations measured from this fork
+            # time (rt_worker_startup_seconds).
+            "RT_SPAWN_TS": repr(time.time()),
         })
+        self._spawned_total += 1
         log_dir = os.path.join(self.config.session_dir_root, self.session,
                                "logs")
         os.makedirs(log_dir, exist_ok=True)
-        self._starting_workers += 1
         log_path = os.path.join(
             log_dir, f"worker-{self.node_id.hex()[:8]}-"
-            f"{self._starting_workers}-{time.time():.0f}.log")
+            f"{self._spawned_total}-{time.time():.0f}.log")
         out = open(log_path, "ab")
         # pip envs: spawn the trampoline, which builds/reuses the venv
         # (file-locked, off this event loop) and execs worker_main
@@ -602,11 +694,18 @@ class NodeAgent:
             module = "ray_tpu.runtime_env.uv_bootstrap"
         else:
             module = "ray_tpu.core.worker_main"
-        proc = subprocess.Popen(
-            [sys.executable, "-u", "-m", module],
-            env=env, stdout=out, stderr=subprocess.STDOUT,
-            start_new_session=True)
-        out.close()
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-u", "-m", module],
+                env=env, stdout=out, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        finally:
+            # The starting/_starting_by_env bookkeeping happens only
+            # AFTER a successful fork: a raising Popen (EAGAIN/ENOMEM
+            # under exactly the fork storms the pool creates) must
+            # not permanently inflate the spawn budgets.
+            out.close()
+        self._starting_workers += 1
         self._worker_log_paths = getattr(self, "_worker_log_paths", {})
         self._worker_log_paths[proc.pid] = log_path
         self._spawned_procs.append(proc)
@@ -626,6 +725,20 @@ class NodeAgent:
     async def register_worker(self, p):
         pending = getattr(self, "_pending_spawns", {}).pop(
             p["pid"], (None, ""))
+        if self._draining:
+            # A spawn that raced the drain decision: this worker can
+            # never be adopted (grants are refused) — kill it now
+            # instead of parking a useless process through the grace.
+            self._starting_done(pending[1])
+            try:
+                if pending[0] is not None:
+                    pending[0].kill()
+                else:
+                    os.kill(p["pid"], signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            return {"ok": False, "draining": True,
+                    "node_id": self.node_id}
         w = WorkerEntry(
             worker_id=p["worker_id"], addr=p["addr"], pid=p["pid"],
             proc=pending[0], state="idle", env_hash=pending[1],
@@ -635,6 +748,12 @@ class NodeAgent:
         self._starting_done(w.env_hash)
         self._idle_q.append(w)
         self._worker_ready.set()
+        for phase, dt in (p.get("phases") or {}).items():
+            try:
+                self._startup_hist.observe(float(dt),
+                                           tags={"phase": str(phase)})
+            except (TypeError, ValueError):
+                pass
         self._kick_scheduler()
         return {"ok": True, "node_id": self.node_id}
 
@@ -770,7 +889,32 @@ class NodeAgent:
         states: Dict[str, int] = {}
         for w in self.workers.values():
             states[w.state] = states.get(w.state, 0) + 1
-        return [
+        pool_idle, _starting, _leased = self._pool_counts("")
+        # The agent's own registry carries rt_worker_startup_seconds
+        # (the only registry metric in this process) — ship it with
+        # the node snapshot so `rt telemetry` sees the phase
+        # histogram without a separate reporting channel.
+        from ..util.metrics import registry
+
+        return list(registry().snapshot()) + [
+            {"name": "rt_worker_pool_idle", "kind": "gauge",
+             "description": "Prestarted idle workers ready for "
+                            "adoption (default runtime env).",
+             "series": [{"tags": {}, "value": pool_idle}]},
+            {"name": "rt_worker_pool_target", "kind": "gauge",
+             "description": "Prestart pool target size.",
+             "series": [{"tags": {},
+                         "value": self._prestart_target()}]},
+            {"name": "rt_worker_adoptions_total", "kind": "counter",
+             "description": "Lease grants served by adopting a warm "
+                            "pooled worker (cumulative).",
+             "series": [{"tags": {}, "value": self._pool_adoptions}]},
+            {"name": "rt_worker_cold_spawn_total", "kind": "counter",
+             "description": "Lease grants that had to wait for a "
+                            "worker process spawn (cumulative).",
+             "series": [{"tags": {},
+                         "value": self._pool_cold_spawns}]},
+        ] + [
             {"name": "rt_node_cpu_util", "kind": "gauge",
              "description": "Host CPU utilization (0-1).",
              "series": [{"tags": {},
@@ -833,12 +977,18 @@ class NodeAgent:
         # per runtime-env hash: a worker warming up for env A must not
         # satisfy the spawn budget of a request for env B.
         want = (runtime_env or {}).get("hash", "")
+        if want:
+            # Remember the env so the prestart pool can keep it warm
+            # (and can re-spawn workers INSIDE it after adoptions).
+            self._env_specs[want] = dict(runtime_env or {})
+            self._env_last_used[want] = time.time()
         acq = getattr(self, "_acquirers_by_env", None)
         if acq is None:
             acq = self._acquirers_by_env = {}
         acq[want] = acq.get(want, 0) + 1
-        deadline = asyncio.get_event_loop().time() + \
-            self.config.worker_start_timeout_s
+        t0 = asyncio.get_event_loop().time()
+        deadline = t0 + self.config.worker_start_timeout_s
+        first_pass = True
         try:
             while True:
                 match = next((w for w in self._idle_q
@@ -846,8 +996,22 @@ class NodeAgent:
                 if match is not None:
                     self._idle_q.remove(match)
                     if match.state == "idle":
+                        if first_pass or match.recycled:
+                            # Warm path: the worker either existed
+                            # before the request (pool hit) or was
+                            # handed back by a finishing lease — no
+                            # fork was paid either way.
+                            self._pool_adoptions += 1
+                        else:
+                            # Waited out a real process spawn.
+                            self._note_cold_spawn()
+                        self._startup_hist.observe(
+                            asyncio.get_event_loop().time() - t0,
+                            tags={"phase": "adopt"})
+                        self._kick_refill()
                         return match
                     continue
+                first_pass = False
                 starting = getattr(self, "_starting_by_env", {}) \
                     .get(want, 0)
                 # Actor-dedicated workers live outside the pool cap —
@@ -893,6 +1057,215 @@ class NodeAgent:
         except (RpcError, asyncio.TimeoutError, OSError):
             if w.proc is not None:
                 w.proc.terminate()
+
+    # ------------------------------------------------ warm prestart pool
+    def _prestart_target(self) -> int:
+        n = self.config.worker_prestart
+        if n < 0:
+            # Auto: the node's CPUs — bounded by the PHYSICAL core
+            # count, not just the declared resource total (test
+            # clusters declare num_cpus=4 on 1-core hosts; prestarting
+            # more processes than cores only adds fork contention).
+            n = min(int(self.total.get("CPU")), os.cpu_count() or 1)
+        return max(0, min(n, self._max_workers()))
+
+    def _prestart_burst(self) -> int:
+        n = self.config.worker_prestart_burst
+        if n <= 0:
+            n = max(2, int(self.total.get("CPU")))
+        return n
+
+    def _note_cold_spawn(self) -> None:
+        """A lease had to wait for a worker spawn (pool miss/empty):
+        the fallback the prestart pool exists to avoid.  Windowed for
+        the doctor's pool-exhaustion check."""
+        self._pool_cold_spawns += 1
+        now = time.time()
+        self._cold_spawn_ts.append(now)
+        if len(self._cold_spawn_ts) > 1024:
+            del self._cold_spawn_ts[:512]
+
+    def _cold_spawns_in_window(self, window_s: float = 60.0) -> int:
+        cutoff = time.time() - window_s
+        return sum(1 for ts in self._cold_spawn_ts if ts >= cutoff)
+
+    def _kick_refill(self) -> None:
+        self._refill_wakeup.set()
+
+    def _pool_counts(self, env_hash: str) -> Tuple[int, int, int]:
+        """(idle, starting, leased) non-actor workers of one env hash."""
+        idle = sum(1 for w in self._idle_q if w.env_hash == env_hash
+                   and w.state == "idle")
+        starting = getattr(self, "_starting_by_env", {}) \
+            .get(env_hash, 0)
+        leased = sum(1 for w in self.workers.values()
+                     if w.state == "leased" and w.env_hash == env_hash)
+        return idle, starting, leased
+
+    async def _prestart_refill_loop(self) -> None:
+        """Keep the prestart pool at target: kicked after every
+        adoption, and ticking on ``worker_prestart_refill_ms`` to heal
+        losses (worker death, env churn).  The refill respects the
+        drain state — a DRAINING node's pool is killed, not warmed."""
+        period = max(self.config.worker_prestart_refill_ms, 10) / 1000.0
+        # Boot warmup: let the agent finish registration/heartbeat
+        # setup before forking the first prestart wave — the pool is
+        # a steady-state optimization, not a boot-path dependency
+        # (and on small shared hosts a fork herd at agent start
+        # races the agent's own ready handshake for CPU).
+        try:
+            await asyncio.wait_for(self._shutdown.wait(), 1.0)
+            return
+        except asyncio.TimeoutError:
+            pass
+        while not self._shutdown.is_set():
+            try:
+                await asyncio.wait_for(self._refill_wakeup.wait(),
+                                       period)
+            except asyncio.TimeoutError:
+                pass
+            self._refill_wakeup.clear()
+            if self._shutdown.is_set() or self._draining:
+                continue
+            try:
+                self._refill_pool_once()
+            except Exception as e:  # noqa: BLE001 — loop must survive
+                # A failed fork (EAGAIN/ENOMEM under load) costs one
+                # tick, never the loop: a dead refill loop would
+                # silently turn every future creation into a cold
+                # spawn for the agent's lifetime.
+                logger.warning("prestart refill failed: %r", e)
+
+    def _refill_pool_once(self) -> None:
+        target = self._prestart_target()
+        if target <= 0:
+            return
+        now = time.time()
+        # Expire stale warm envs: drop their specs AND retire their
+        # already-prestarted idle workers — default-env requests can
+        # never adopt a mismatched env hash, so without this the
+        # orphaned interpreters would hold RSS (and count against
+        # max_workers room) for the agent's lifetime.
+        ttl = self.config.worker_prestart_env_ttl_s
+        for h in [h for h, ts in self._env_last_used.items()
+                  if now - ts > ttl]:
+            self._env_last_used.pop(h, None)
+            self._env_specs.pop(h, None)
+            for w in [w for w in self._idle_q
+                      if w.env_hash == h and w.state == "idle"]:
+                self._idle_q.remove(w)
+                spawn_task(self._retire_worker(w))
+        targets = warm_env_targets(now, target, self._env_last_used,
+                                   ttl)
+        pending = len(getattr(self, "_pending_spawns", {}))
+        burst = self._prestart_burst()
+        active = sum(1 for w in self.workers.values()
+                     if w.state != "actor") + self._starting_workers
+        for env_hash, env_target in targets.items():
+            idle, starting, leased = self._pool_counts(env_hash)
+            n = pool_plan(
+                target=env_target, idle=idle, starting=starting,
+                leased=leased, pending_spawns=pending, burst=burst,
+                max_workers=self._max_workers(), active=active,
+                draining=self._draining)
+            renv = self._env_specs.get(env_hash) if env_hash else None
+            for _ in range(n):
+                self._spawn_worker(renv)
+                pending += 1
+                active += 1
+
+    def _kill_prestart_pool(self) -> None:
+        """DRAINING: idle pooled workers are pure warmth — kill them
+        immediately so the grace window's CPU goes to migration work,
+        and reap in-flight prestart spawns on arrival (the reap loop
+        handles those when they register post-drain via _try_grant's
+        refusal; unregistered ones die with the agent)."""
+        idle, self._idle_q = self._idle_q, []
+        for w in idle:
+            w.state = "dead"
+            self.workers.pop(w.worker_id, None)
+            try:
+                if w.proc is not None:
+                    w.proc.kill()
+                else:
+                    os.kill(w.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        if idle:
+            logger.info("drain: killed %d prestarted idle worker(s)",
+                        len(idle))
+
+    def _pool_stats_snapshot(self) -> Dict[str, Any]:
+        idle, starting, leased = self._pool_counts("")
+        idle_all = sum(1 for w in self._idle_q if w.state == "idle")
+        hist_counts: Dict[str, int] = {}
+        for s in self._startup_hist._snapshot().get("series", []):
+            phase = (s.get("tags") or {}).get("phase", "?")
+            hist_counts[phase] = int(s.get("hist", {}).get("count", 0))
+        return {"node_id": self.node_id.hex(),
+                "target": self._prestart_target(),
+                "idle": idle, "idle_all": idle_all,
+                "starting": starting, "leased": leased,
+                "pending_spawns": len(getattr(self, "_pending_spawns",
+                                              {})),
+                "adoptions": self._pool_adoptions,
+                "cold_spawns": self._pool_cold_spawns,
+                "cold_spawns_60s": self._cold_spawns_in_window(),
+                "spawned_total": self._spawned_total,
+                "warm_envs": sorted(self._env_last_used),
+                "draining": self._draining,
+                "startup": hist_counts}
+
+    async def pool_stats(self, _p=None):
+        """The prestart pool's books (scale benches, `rt doctor`,
+        tests): adoption vs cold-spawn counters, occupancy, and
+        startup-phase sample counts."""
+        return self._pool_stats_snapshot()
+
+    # -------------------------------------- batched actor-started relay
+    async def report_actor_started(self, p):
+        """Relay a worker's actor hello to the controller, COALESCED:
+        a creation fan-out (100 serve replicas, an RL env-runner
+        fleet) becomes a handful of bulk ``actors_started`` RPCs on
+        one persistent connection instead of a fresh controller dial
+        per actor.  The worker still gets its per-actor reply (the
+        kill-during-creation verdict rides it)."""
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._actor_started_buf.append((p, fut))
+        if not self._actor_started_scheduled:
+            self._actor_started_scheduled = True
+            asyncio.get_event_loop().call_later(
+                0.005, lambda: spawn_task(self._flush_actor_started()))
+        return await fut
+
+    async def _flush_actor_started(self) -> None:
+        self._actor_started_scheduled = False
+        items, self._actor_started_buf = self._actor_started_buf, []
+        if not items:
+            return
+        try:
+            r = await self._ctl.call(
+                "actors_started", {"items": [p for p, _f in items]})
+            results = r.get("results") or []
+        except (RpcError, RemoteCallError) as e:
+            # BOTH transport loss and a controller-side handler error
+            # must resolve the futures — an escaped exception here
+            # would leave every worker in the batch awaiting its
+            # hello reply forever.
+            for _p, fut in items:
+                if not fut.done():
+                    fut.set_exception(RpcError(
+                        f"actor-started relay failed: {e}"))
+            return
+        for (_p, fut), res in zip(items, results):
+            if not fut.done():
+                fut.set_result(res if res is not None
+                               else {"ok": False})
+        # Length mismatch (controller bug): fail the unanswered rest.
+        for _p, fut in items[len(results):]:
+            if not fut.done():
+                fut.set_exception(RpcError(
+                    "actors_started reply shorter than request"))
 
     # ----------------------------------------------------------- scheduling
     def _kick_scheduler(self) -> None:
@@ -1241,6 +1614,7 @@ class NodeAgent:
         if worker_back and w.state == "leased":
             w.state = "idle"
             w.actor_id = None
+            w.recycled = True
             self._idle_q.append(w)
             self._worker_ready.set()
         self._kick_scheduler()
@@ -1493,7 +1867,10 @@ class NodeAgent:
                 "leases": leases, "pending": pending,
                 "demand": self._demand_vector(),
                 "available": dict(self.available.amounts),
-                "total": dict(self.total.amounts)}
+                "total": dict(self.total.amounts),
+                # Pool occupancy rides the ledger so `rt doctor`'s
+                # pool-exhaustion check needs no extra fan-out.
+                "worker_pool": self._pool_stats_snapshot()}
 
     async def report_collective_entries(self, p):
         """Relay a worker's inflight collective-entry stamps to the
@@ -2073,6 +2450,10 @@ class NodeAgent:
         self._drain_reason = reason
         self._drain_deadline = time.time() + max(grace_s, 0.0)
         self._drain_replace = replace
+        # The prestart pool dies with the drain decision: warm idle
+        # workers on a node about to die are wasted CPU/RSS, and the
+        # refill loop checks _draining before every spawn.
+        self._kill_prestart_pool()
         logger.warning("node DRAINING (%s): deadline in %.1fs, "
                        "%d lease(s) held, %d queued request(s)",
                        reason, grace_s, len(self.leases),

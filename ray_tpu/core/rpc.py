@@ -22,7 +22,19 @@ import pickle
 import threading
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
-import cloudpickle
+# cloudpickle loads on the first frame encode, not at import:
+# rpc sits on every process's spawn path (see core/serialization
+# for the same discipline).
+_cloudpickle = None
+
+
+def _cp():
+    global _cloudpickle
+    if _cloudpickle is None:
+        import cloudpickle
+
+        _cloudpickle = cloudpickle
+    return _cloudpickle
 
 logger = logging.getLogger(__name__)
 
@@ -57,7 +69,7 @@ async def _read_frame(reader: asyncio.StreamReader) -> Tuple:
 
 def _encode_frame(msg: Tuple) -> bytes:
     # 8-byte length prefix: object-transfer frames can exceed 4 GiB.
-    data = cloudpickle.dumps(msg, protocol=5)
+    data = _cp().dumps(msg, protocol=5)
     return len(data).to_bytes(8, "little") + data
 
 
@@ -74,7 +86,7 @@ def _encode_frame_fast(msg: Tuple) -> bytes:
     try:
         data = pickle.dumps(msg, protocol=5)
     except Exception:
-        data = cloudpickle.dumps(msg, protocol=5)
+        data = _cp().dumps(msg, protocol=5)
     return len(data).to_bytes(8, "little") + data
 
 

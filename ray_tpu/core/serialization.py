@@ -13,7 +13,20 @@ from __future__ import annotations
 import pickle
 from typing import Any, List, Tuple
 
-import cloudpickle
+# cloudpickle is imported on FIRST USE, not at module import: this
+# module sits on every process's import path (worker_main pulls it at
+# spawn), and prestarted pool workers must be cheap to fork — most
+# never serialize anything until their first task arrives.
+_cloudpickle = None
+
+
+def _cp():
+    global _cloudpickle
+    if _cloudpickle is None:
+        import cloudpickle
+
+        _cloudpickle = cloudpickle
+    return _cloudpickle
 
 # Header layout of a stored object:
 #   u32 num_buffers | u64 pickled_len | pickled bytes |
@@ -43,7 +56,8 @@ def serialize(value: Any) -> Tuple[bytes, List[memoryview]]:
     """Return (metadata_bytes, out_of_band_buffers)."""
     buffers: List[pickle.PickleBuffer] = []
     value = _to_host(value)
-    payload = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    payload = _cp().dumps(value, protocol=5,
+                          buffer_callback=buffers.append)
     views = [b.raw() for b in buffers]
     return payload, views
 
@@ -117,7 +131,7 @@ def ensure_code_portable(obj: Any) -> None:
     if "site-packages" in file or "dist-packages" in file or not file:
         return
     try:
-        cloudpickle.register_pickle_by_value(mod)
+        _cp().register_pickle_by_value(mod)
         _by_value_registered.add(mod_name)
     except Exception:
         pass
@@ -126,12 +140,12 @@ def ensure_code_portable(obj: Any) -> None:
 def dumps_code(obj: Any) -> bytes:
     """cloudpickle for code objects shipped to workers."""
     ensure_code_portable(obj)
-    return cloudpickle.dumps(obj, protocol=5)
+    return _cp().dumps(obj, protocol=5)
 
 
 def dumps_message(msg: Any) -> bytes:
     """Control-plane message serialization (small, no out-of-band)."""
-    return cloudpickle.dumps(msg, protocol=5)
+    return _cp().dumps(msg, protocol=5)
 
 
 def loads_message(data: bytes) -> Any:

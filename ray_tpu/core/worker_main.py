@@ -17,29 +17,44 @@ python/ray/_private/accelerators/tpu.py TPU_VISIBLE_CHIPS).
 
 from __future__ import annotations
 
-import asyncio
-import faulthandler
-import inspect
-import logging
-import os
-import signal as _signal
-import sys
-import threading
-import time
-import traceback
-from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional, Tuple
+import os as _os_early
+import time as _time_early
 
-import cloudpickle
+# Startup-phase anchors (rt_worker_startup_seconds): the agent stamps
+# RT_SPAWN_TS at fork; everything between it and this line is the
+# "spawn" phase (fork + interpreter boot + site), everything from here
+# to the end of this module's import is the "import" phase.  These two
+# lines must stay ABOVE the heavy imports to measure them.
+_SPAWN_TS = float(_os_early.environ.get("RT_SPAWN_TS") or 0.0)
+_IMPORT_T0 = _time_early.time()
 
-from . import runtime as runtime_mod
-from . import serialization
-from .cluster_runtime import ClusterRuntime
-from .config import RuntimeConfig
-from .errors import ActorError, TaskCancelledError, TaskError
-from .ids import ActorID, JobID, WorkerID
-from .rpc import RpcClient, RpcError, RpcServer, spawn_task
-from .task import ArgKind, TaskResult, TaskSpec
+import asyncio  # noqa: E402
+import faulthandler  # noqa: E402
+import inspect  # noqa: E402
+import logging  # noqa: E402
+import os  # noqa: E402
+import signal as _signal  # noqa: E402
+import sys  # noqa: E402
+import threading  # noqa: E402
+import time  # noqa: E402
+import traceback  # noqa: E402
+from concurrent.futures import ThreadPoolExecutor  # noqa: E402
+from typing import Any, Dict, List, Optional, Tuple  # noqa: E402
+
+# NOTE: cloudpickle (via serialization/rpc lazy accessors), jax,
+# telemetry, and the collective stack are imported lazily at first
+# use — a prestarted pool worker must be cheap to fork, and most
+# workers never touch most of that stack until their first frame.
+from . import runtime as runtime_mod  # noqa: E402
+from . import serialization  # noqa: E402
+from .cluster_runtime import ClusterRuntime  # noqa: E402
+from .config import RuntimeConfig  # noqa: E402
+from .errors import ActorError, TaskCancelledError, TaskError  # noqa: E402
+from .ids import ActorID, JobID, WorkerID  # noqa: E402
+from .rpc import RpcClient, RpcError, RpcServer, spawn_task  # noqa: E402
+from .task import ArgKind, TaskResult, TaskSpec  # noqa: E402
+
+_IMPORT_DONE = _time_early.time()
 
 logger = logging.getLogger("ray_tpu.worker")
 
@@ -126,9 +141,13 @@ class Worker:
                           tag=f"worker-{self.worker_id.hex()[:8]}",
                           connect_timeout=10.0)
         await agent.connect()
+        phases = {"import": max(_IMPORT_DONE - _IMPORT_T0, 0.0),
+                  "connect": max(time.time() - _IMPORT_DONE, 0.0)}
+        if _SPAWN_TS:
+            phases["spawn"] = max(_IMPORT_T0 - _SPAWN_TS, 0.0)
         await agent.call("register_worker", {
             "worker_id": self.worker_id, "addr": self.server.address,
-            "pid": os.getpid()})
+            "pid": os.getpid(), "phases": phases})
         self._agent = agent
         spawn_task(self._watch_agent())
         spawn_task(self._flush_loop())
@@ -268,6 +287,8 @@ class Worker:
     def _load_func(self, spec: TaskSpec):
         fn = self._func_cache.get(spec.func_id)
         if fn is None:
+            import cloudpickle  # lazy: keep prestarted forks cheap
+
             fn = cloudpickle.loads(spec.func_blob)
             self._func_cache[spec.func_id] = fn
         return fn
@@ -926,16 +947,16 @@ class Worker:
                                  if n == 1
                                  and not self._group_executors
                                  else None)
-        ctl = RpcClient(self.controller_addr,
-                        tag=f"actor-{spec.actor_id.hex()[:8]}")
-        await ctl.connect()
         from .ids import NodeID
 
-        r = await ctl.call("actor_started", {
+        # Through the agent's batched relay (one persistent controller
+        # connection, bulk actors_started frames on a 5 ms window) —
+        # NOT a fresh per-actor controller dial: a 100-replica fan-out
+        # registers in a handful of round trips.
+        r = await self._agent.call("report_actor_started", {
             "actor_id": spec.actor_id,
             "node_id": NodeID.from_hex(self.node_id_hex),
             "worker_addr": self.server.address})
-        await ctl.close()
         if r.get("kill"):
             self._exit_event.set()
             return {"ok": False, "error": "actor killed during creation"}

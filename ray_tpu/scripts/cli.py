@@ -255,6 +255,12 @@ def cmd_status(args) -> int:
         print(f"  {state} {nid[:12]} @ {n['agent_addr']}{head}")
         print(f"         total: {res or '-'}")
         print(f"         avail: {avail or '-'}")
+        pool = n.get("worker_pool") or {}
+        if pool.get("target"):
+            print(f"         pool:  {pool.get('idle', 0)}/"
+                  f"{pool['target']} warm worker(s) idle  "
+                  f"(adopted {pool.get('adoptions', 0)}, "
+                  f"cold spawns {pool.get('cold_spawns', 0)})")
     return 0
 
 

@@ -324,6 +324,57 @@ def find_never_idle_nodes(load: Dict, ledgers: List[Dict],
     return out
 
 
+def find_pool_exhaustion(ledgers: List[Dict],
+                         min_cold_spawns: int = 3) -> List[Dict]:
+    """Warm-worker prestart pool exhaustion: a node whose pool target
+    is set but whose idle pool is EMPTY while recent grants kept
+    falling back to cold process spawns — every actor/task creation
+    is paying the full interpreter-spawn latency the pool exists to
+    hide.  Sustained means >= ``min_cold_spawns`` cold spawns in the
+    agent's 60 s window (one-off misses right after a mass adoption
+    are the refill loop doing its job, not a finding)."""
+    out = []
+    for ledger in ledgers or []:
+        pool = ledger.get("worker_pool") or {}
+        node = str(ledger.get("node_id", "?"))[:12]
+        if not pool.get("target") or pool.get("draining"):
+            continue
+        cold_60s = pool.get("cold_spawns_60s", 0)
+        if cold_60s < min_cold_spawns:
+            continue
+        # idle_all covers every warm env hash; a nonzero idle pool can
+        # still be MISSING the requested env (pip/working_dir fleets),
+        # so sustained cold spawns past the pool's own size fire the
+        # finding even with idle workers on the books.
+        idle = pool.get("idle_all", pool.get("idle", 0))
+        if idle > 0 and cold_60s < max(min_cold_spawns,
+                                       pool.get("target", 0)):
+            continue
+        why = ("prestart pool empty" if idle == 0 else
+               f"{idle} idle worker(s) did not match the requested "
+               f"runtime env")
+        out.append(_finding(
+            "worker_pool_exhausted", "warning",
+            f"node {node}: {why}, {cold_60s} cold spawn(s) in the "
+            f"last 60s (target {pool['target']})",
+            detail="creation demand is outrunning the warm pool — "
+                   "actor/task starts are paying full process "
+                   "spawns (~seconds each) instead of adopting "
+                   "idle workers.  The refill loop may be "
+                   "throttled by the spawn-burst hysteresis, the "
+                   "target may be too small for this fleet's churn, "
+                   "or the fleet uses a runtime env the pool has not "
+                   "warmed yet.",
+            probe="rt status  (pool column); raise "
+                  "RT_WORKER_PRESTART / RT_WORKER_PRESTART_BURST",
+            data={"node": node,
+                  **{k: pool.get(k) for k in
+                     ("target", "idle", "idle_all", "starting",
+                      "cold_spawns_60s", "adoptions",
+                      "cold_spawns")}}))
+    return out
+
+
 def find_draining_nodes(nodes: List[Dict], now: float) -> List[Dict]:
     """Surface every node in the DRAINING lifecycle state: an active
     drain is a warning naming the node, reason, and remaining grace
@@ -536,6 +587,7 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
         collective_watchdog_s)
     findings += find_draining_nodes(nodes, now)
     findings += find_lease_problems(ledgers, now)
+    findings += find_pool_exhaustion(ledgers)
     findings += find_infeasible_pgs(pgs, nodes)
     findings += find_starved_jobs(pgs, now, warn_s=starvation_warn_s)
     findings += find_stuck_tasks(tasks, now, min_s=stuck_task_min_s,

@@ -53,7 +53,13 @@ FLOORS: Dict[str, "tuple[float, int]"] = {
     "micro/put_get_4mb": (655.0, 6),
     "scale/many_tasks_inflight_10000": (1000.0, 5),
     "scale/queue_submit_100000": (3000.0, 5),
-    "scale/many_actors_50": (0.5, 5),
+    # r7 control-plane fast path: warm-worker prestart pool + actor
+    # adoption + batched controller registration lift actor creation
+    # from 2.6 ops/s (every actor paying a full interpreter spawn) to
+    # the warm-adoption regime; the floor ratchets 0.5 -> 10.0 (the
+    # VERDICT "ledger floor should ratchet to the real target") with
+    # headroom under the >=26 ops/s measured bar.
+    "scale/many_actors_50": (10.0, 7),
 }
 
 

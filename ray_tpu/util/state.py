@@ -250,6 +250,23 @@ def list_leases(*, node_id: Optional[str] = None,
     return out
 
 
+def worker_pools(*, node_id: Optional[str] = None,
+                 address: Optional[str] = None) -> List[Dict]:
+    """Fan out over alive node agents and return each node's warm
+    prestart-pool books (occupancy, adoption vs cold-spawn counters,
+    startup-phase sample counts) — the scale benches' pool-hit report
+    and the data behind the `rt status` pool column."""
+    out = []
+    for n in _agents(node_id, address):
+        try:
+            out.append(_agent_call(n["agent_addr"], "pool_stats"))
+        except Exception as e:  # noqa: BLE001 — one dead agent must
+            # not hide every other node's pool
+            out.append({"node_id": n["node_id"],
+                        "error": f"agent unreachable: {e}"})
+    return out
+
+
 def doctor(*, address: Optional[str] = None) -> Dict[str, Any]:
     """The aggregated health diagnosis (``rt doctor`` /
     ``/api/doctor``); see util/doctor.py for the checks."""

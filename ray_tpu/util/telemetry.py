@@ -84,6 +84,8 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
     serve: Dict[str, Any] = {}
     object_store = {"spilled_bytes": 0.0, "spill_total": 0.0,
                     "restore_total": 0.0}
+    worker_pool = {"idle": 0.0, "target": 0.0, "adoptions": 0.0,
+                   "cold_spawns": 0.0, "startup": {}}
     for src, snap in _iter_metrics(sources):
         name = snap.get("name", "")
         if name in ("rt_object_spilled_bytes", "rt_object_spill_total",
@@ -91,6 +93,35 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
             key = name.replace("rt_object_", "")
             for s in snap.get("series", []):
                 object_store[key] += float(s.get("value", 0.0))
+            continue
+        if name in ("rt_worker_pool_idle", "rt_worker_pool_target",
+                    "rt_worker_adoptions_total",
+                    "rt_worker_cold_spawn_total"):
+            key = {"rt_worker_pool_idle": "idle",
+                   "rt_worker_pool_target": "target",
+                   "rt_worker_adoptions_total": "adoptions",
+                   "rt_worker_cold_spawn_total": "cold_spawns"}[name]
+            for s in snap.get("series", []):
+                worker_pool[key] += float(s.get("value", 0.0))
+            continue
+        if name == "rt_worker_startup_seconds":
+            for s in snap.get("series", []):
+                phase = (s.get("tags") or {}).get("phase", "?")
+                stats = _hist_stats(snap.get("boundaries", []),
+                                    s.get("hist", {}))
+                cur = worker_pool["startup"].get(phase)
+                if cur is None:
+                    worker_pool["startup"][phase] = stats
+                else:
+                    # Merge across nodes: exact for count/sum/mean,
+                    # conservative (max) for the quantile bounds.
+                    n = cur["count"] + stats["count"]
+                    total = cur["sum"] + stats["sum"]
+                    worker_pool["startup"][phase] = {
+                        "count": n, "sum": total,
+                        "mean": (total / n) if n else 0.0,
+                        "p50": max(cur["p50"], stats["p50"]),
+                        "p99": max(cur["p99"], stats["p99"])}
             continue
         if name in TRAIN_GAUGES:
             row = train.setdefault(src, {})
@@ -147,6 +178,7 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
         "collectives": collectives,
         "serve": serve,
         "object_store": object_store,
+        "worker_pool": worker_pool,
         "flight": raw.get("flight", []),
     }
 
@@ -236,6 +268,23 @@ def render_text(summary: Dict[str, Any]) -> str:
                          f"{h['mean'] * 1e3:.1f}ms  p99≤"
                          f"{h['p99'] * 1e3:.1f}ms")
         lines.append(f"  in-flight now: {serve.get('inflight', 0):.0f}")
+
+    pool = summary.get("worker_pool") or {}
+    if pool.get("target") or pool.get("adoptions") \
+            or pool.get("cold_spawns"):
+        lines.append("\nWorker pool (control-plane fast path):")
+        lines.append(f"  warm idle     {pool.get('idle', 0):.0f} / "
+                     f"{pool.get('target', 0):.0f} target")
+        lines.append(f"  adoptions     {pool.get('adoptions', 0):.0f}")
+        lines.append(f"  cold spawns   "
+                     f"{pool.get('cold_spawns', 0):.0f}")
+        for phase in ("spawn", "import", "connect", "adopt"):
+            h = (pool.get("startup") or {}).get(phase)
+            if h and h["count"]:
+                lines.append(
+                    f"  {phase:<12}  mean {h['mean'] * 1e3:.1f}ms  "
+                    f"p50≤{h['p50'] * 1e3:.1f}ms  "
+                    f"p99≤{h['p99'] * 1e3:.1f}ms  n={h['count']}")
 
     objs = summary.get("object_store") or {}
     if any(objs.values()):

@@ -1,0 +1,246 @@
+"""Warm-worker prestart pool + batched registration — pure units.
+
+The control-plane fast path (ISSUE 7): pool sizing/refill planning,
+env-hash warm sets, spawn-storm hysteresis, the doctor's
+pool-exhaustion check, and the controller's bulk register_actors /
+actors_started RPCs.  The live adoption behavior (spawn counters flat
+while a fleet boots, drain killing the pool, agent-restart survival)
+is covered by tests/test_worker_pool_cluster.py.
+"""
+
+import asyncio
+import types
+
+from ray_tpu.core.node_agent import pool_plan, warm_env_targets
+from ray_tpu.util.doctor import find_pool_exhaustion
+
+
+# ------------------------------------------------------------ pool_plan
+def _plan(**kw):
+    base = dict(target=4, idle=0, starting=0, leased=0,
+                pending_spawns=0, burst=4, max_workers=16, active=0,
+                draining=False)
+    base.update(kw)
+    return pool_plan(**base)
+
+
+def test_plan_spawns_full_deficit_when_empty():
+    assert _plan() == 4
+
+
+def test_plan_noop_when_pool_full():
+    assert _plan(idle=4) == 0
+    assert _plan(idle=6) == 0  # over target: never negative
+
+
+def test_plan_counts_starting_and_leased_toward_target():
+    # A leased (task) worker returns to the pool; a starting worker is
+    # about to join it — neither justifies another fork.
+    assert _plan(idle=1, starting=2, leased=1) == 0
+    assert _plan(idle=1, starting=1, leased=1) == 1
+
+
+def test_plan_burst_hysteresis_bounds_the_fork_herd():
+    assert _plan(target=50, burst=4) == 4
+    assert _plan(target=50, burst=4, pending_spawns=3) == 1
+    assert _plan(target=50, burst=4, pending_spawns=4) == 0
+    # Over-budget (e.g. demand-driven spawns in flight) never goes
+    # negative.
+    assert _plan(target=50, burst=4, pending_spawns=9) == 0
+
+
+def test_plan_respects_max_workers_cap():
+    assert _plan(target=10, burst=32, max_workers=8, active=6) == 2
+    assert _plan(target=10, burst=32, max_workers=8, active=8) == 0
+
+
+def test_plan_draining_and_disabled_never_spawn():
+    assert _plan(draining=True) == 0
+    assert _plan(target=0) == 0
+    assert _plan(target=-1) == 0
+
+
+# ----------------------------------------------------- warm env targets
+def test_warm_envs_default_always_included():
+    assert warm_env_targets(100.0, 3, {}, 60.0) == {"": 3}
+
+
+def test_warm_envs_fresh_hash_gets_full_target():
+    out = warm_env_targets(100.0, 3, {"abc": 90.0, "old": 10.0}, 60.0)
+    assert out == {"": 3, "abc": 3}
+
+
+def test_warm_envs_empty_hash_never_duplicates_default():
+    out = warm_env_targets(100.0, 3, {"": 99.0}, 60.0)
+    assert out == {"": 3}
+
+
+# ------------------------------------------------- doctor: pool checks
+def _ledger(**pool):
+    base = {"target": 4, "idle": 0, "starting": 0,
+            "cold_spawns_60s": 0, "adoptions": 10, "cold_spawns": 0,
+            "draining": False}
+    base.update(pool)
+    return {"node_id": "deadbeef1234", "leases": [],
+            "worker_pool": base}
+
+
+def test_pool_exhaustion_flags_sustained_cold_spawns():
+    out = find_pool_exhaustion([_ledger(cold_spawns_60s=5)])
+    assert len(out) == 1
+    f = out[0]
+    assert f["check"] == "worker_pool_exhausted"
+    assert f["severity"] == "warning"
+    assert "5 cold spawn(s)" in f["summary"]
+    assert f["data"]["target"] == 4
+
+
+def test_pool_exhaustion_quiet_when_pool_has_idle_workers():
+    # Idle workers on the books and cold spawns below the pool's own
+    # size: the refill is just catching up, not outrun.
+    assert find_pool_exhaustion([_ledger(idle=2, idle_all=2,
+                                         cold_spawns_60s=3)]) == []
+
+
+def test_pool_exhaustion_fires_on_env_hash_misses():
+    # A full default-env pool is no help to a fleet on a different
+    # runtime env: sustained cold spawns past the target fire the
+    # finding even with idle workers present.
+    out = find_pool_exhaustion([_ledger(idle=4, idle_all=8,
+                                        cold_spawns_60s=8)])
+    assert len(out) == 1
+    assert "did not match the requested runtime env" in \
+        out[0]["summary"]
+
+
+def test_pool_exhaustion_quiet_below_sustained_threshold():
+    assert find_pool_exhaustion([_ledger(cold_spawns_60s=2)]) == []
+
+
+def test_pool_exhaustion_quiet_when_disabled_or_draining():
+    assert find_pool_exhaustion([_ledger(target=0,
+                                         cold_spawns_60s=9)]) == []
+    assert find_pool_exhaustion([_ledger(draining=True,
+                                         cold_spawns_60s=9)]) == []
+    assert find_pool_exhaustion([{"node_id": "x", "leases": []}]) == []
+
+
+# ------------------------------- controller: batched registration RPCs
+def _controller():
+    from ray_tpu.core.config import RuntimeConfig
+    from ray_tpu.core.controller import Controller
+
+    return Controller(RuntimeConfig.from_env(), "pool-unit")
+
+
+def _spec(name=""):
+    from ray_tpu.core.ids import ActorID
+
+    return types.SimpleNamespace(
+        actor_id=ActorID.from_random(), actor_name=name, namespace="",
+        max_restarts=0, max_concurrency=1, concurrency_groups={},
+        method_options={})
+
+
+def test_register_actors_bulk_matches_single_semantics():
+    ctl = _controller()
+    specs = [_spec(), _spec("dup"), _spec("dup")]
+
+    async def go():
+        return await ctl.register_actors({"items": [
+            {"spec": s, "class_name": "C", "method_names": ["m"],
+             "detached": False, "owner_addr": "own"} for s in specs]})
+
+    r = asyncio.run(go())
+    results = r["results"]
+    assert [x["ok"] for x in results] == [True, True, False]
+    assert "taken" in results[2]["error"]
+    # Both successful registrations landed in the actor table.
+    assert specs[0].actor_id in ctl.actors
+    assert specs[1].actor_id in ctl.actors
+    assert specs[2].actor_id not in ctl.actors
+
+
+def test_actors_started_bulk_marks_alive_per_item():
+    ctl = _controller()
+    from ray_tpu.core.ids import NodeID
+
+    specs = [_spec(), _spec()]
+    ghost = _spec()
+
+    async def go():
+        await ctl.register_actors({"items": [
+            {"spec": s, "class_name": "C", "method_names": ["m"],
+             "detached": False, "owner_addr": "own"} for s in specs]})
+        return await ctl.actors_started({"items": [
+            {"actor_id": s.actor_id, "node_id": NodeID.from_random(),
+             "worker_addr": f"w{i}"}
+            for i, s in enumerate(specs + [ghost])]})
+
+    r = asyncio.run(go())
+    oks = [x.get("ok") for x in r["results"]]
+    assert oks == [True, True, False]  # ghost was never registered
+    for i, s in enumerate(specs):
+        assert ctl.actors[s.actor_id].state == "ALIVE"
+        assert ctl.actors[s.actor_id].worker_addr == f"w{i}"
+
+
+def test_heartbeat_from_marked_dead_node_demands_reregister():
+    """An agent whose loop stalled past the health threshold (e.g. a
+    500-worker prestart fork storm on a small host) must not become a
+    permanent zombie: its next heartbeat gets the re-register signal
+    and registration resurrects the row."""
+    ctl = _controller()
+    from ray_tpu.core.ids import NodeID
+
+    nid = NodeID.from_random()
+
+    async def go():
+        await ctl.register_node({
+            "node_id": nid, "agent_addr": "a:1",
+            "resources": {"CPU": 1.0}, "labels": {}, "is_head": True})
+        await ctl._mark_node_dead(ctl.nodes[nid], "missed heartbeats")
+        r1 = await ctl.heartbeat({"node_id": nid,
+                                  "available": {"CPU": 1.0}})
+        await ctl.register_node({
+            "node_id": nid, "agent_addr": "a:1",
+            "resources": {"CPU": 1.0}, "labels": {}, "is_head": True})
+        r2 = await ctl.heartbeat({"node_id": nid,
+                                  "available": {"CPU": 1.0}})
+        return r1, r2
+
+    r1, r2 = asyncio.run(go())
+    assert r1 == {"ok": False, "reregister": True}
+    assert r2["ok"] is True
+    assert ctl.nodes[nid].alive is True
+
+
+def test_heartbeat_mirrors_pool_and_keeps_idle_accounting():
+    """Prestarted idle workers must not distort autoscaler accounting:
+    the idle_s an agent reports (leases/bundles only, never the warm
+    pool) passes through to load metrics untouched, and the pool
+    occupancy shows up in the node row for `rt status`."""
+    ctl = _controller()
+    from ray_tpu.core.ids import NodeID
+
+    nid = NodeID.from_random()
+
+    async def go():
+        await ctl.register_node({
+            "node_id": nid, "agent_addr": "a:1",
+            "resources": {"CPU": 4.0}, "labels": {}, "is_head": True})
+        await ctl.heartbeat({
+            "node_id": nid, "available": {"CPU": 4.0},
+            "total": {"CPU": 4.0}, "idle_s": 42.0,
+            "pending_demands": [],
+            "worker_pool": {"idle": 4, "target": 4,
+                            "adoptions": 7, "cold_spawns": 1}})
+        return (await ctl.get_load_metrics({}),
+                await ctl.list_nodes({}))
+
+    load, nodes = asyncio.run(go())
+    # A FULL warm pool with zero work: the node still reads idle.
+    assert load["nodes"][nid.hex()]["idle_s"] == 42.0
+    row = [n for n in nodes if n["node_id"] == nid][0]
+    assert row["worker_pool"] == {"idle": 4, "target": 4,
+                                  "adoptions": 7, "cold_spawns": 1}

@@ -1,0 +1,197 @@
+"""Warm-worker prestart pool — live 2-node cluster behavior.
+
+Covers the ISSUE-7 acceptance paths that need real processes: the pool
+prefilling at agent boot, actor creation ADOPTING pooled workers (the
+cold-spawn fallback counter stays flat while a fleet is created),
+prestarted idle workers not pinning a node's autoscaler idle clock,
+survival across an agent restart, and the drain integration (a
+DRAINING agent kills its pool and the refill loop stays quiet).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import state
+
+POOL_ENV = {
+    "RT_WORKER_PRESTART": "6",
+    "RT_WORKER_PRESTART_BURST": "4",
+    "RT_WORKER_PRESTART_REFILL_MS": "100",
+}
+
+
+@pytest.fixture(scope="module")
+def pool_cluster():
+    old = {k: os.environ.get(k) for k in POOL_ENV}
+    os.environ.update(POOL_ENV)
+    c = Cluster(head_node_args={"num_cpus": 2})
+    c.add_node(num_cpus=2, resources={"side": 100})
+    ray_tpu.init(address=c.address)
+    c.wait_for_nodes()
+    try:
+        yield c
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _pools(node_id=None):
+    return [p for p in state.worker_pools(node_id=node_id)
+            if "error" not in p]
+
+
+def _totals(node_id=None):
+    tot = {"idle": 0, "adoptions": 0, "cold_spawns": 0, "target": 0}
+    for p in _pools(node_id):
+        for k in tot:
+            tot[k] += p.get(k, 0) or 0
+    return tot
+
+
+def _wait_idle(n, node_id=None, timeout=90.0):
+    deadline = time.time() + timeout
+    idle = -1
+    while time.time() < deadline:
+        idle = _totals(node_id)["idle"]
+        if idle >= n:
+            return idle
+        time.sleep(0.2)
+    raise TimeoutError(f"pool never reached {n} idle (at {idle})")
+
+
+@ray_tpu.remote(num_cpus=0)
+class Probe:
+    def ping(self):
+        return os.getpid()
+
+
+@ray_tpu.remote(num_cpus=0, resources={"side": 1})
+class SideProbe:
+    def ping(self):
+        return os.getpid()
+
+
+def test_pool_prefills_at_boot(pool_cluster):
+    # 6 per node x 2 nodes, filled by the refill loop shortly after
+    # agent start (1s boot warmup + burst-throttled trickle).
+    assert _wait_idle(12) >= 12
+    for p in _pools():
+        assert p["target"] == 6
+        assert p["draining"] is False
+        # Worker hellos stamped the startup breakdown.
+        assert p["startup"].get("import", 0) > 0
+        assert p["startup"].get("connect", 0) > 0
+        assert p["startup"].get("spawn", 0) > 0
+
+
+def test_small_fleet_adopts_without_cold_spawns(pool_cluster):
+    _wait_idle(12)
+    before = _totals()
+    actors = [Probe.remote() for _ in range(4)]
+    actors += [SideProbe.remote() for _ in range(4)]
+    pids = ray_tpu.get([a.ping.remote() for a in actors], timeout=120)
+    assert len(set(pids)) == 8  # one dedicated process each
+    after = _totals()
+    assert after["cold_spawns"] - before["cold_spawns"] == 0
+    assert after["adoptions"] - before["adoptions"] >= 8
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_warm_pool_does_not_pin_idle_clock(pool_cluster):
+    """Prestarted idle workers must not distort autoscaler accounting:
+    with the pool full and zero work, every node's idle_s keeps
+    growing (the never-idle hazard that kept TPU slices from scaling
+    down)."""
+    _wait_idle(8)
+    deadline = time.time() + 60.0
+    while time.time() < deadline:
+        load = state.load_metrics()
+        idles = [n.get("idle_s", 0.0)
+                 for n in (load.get("nodes") or {}).values()]
+        if idles and min(idles) >= 1.5:
+            return
+        time.sleep(0.3)
+    raise AssertionError(
+        f"nodes never went idle with a warm pool: {idles}")
+
+
+@pytest.mark.slow
+def test_fifty_actor_fleet_cold_spawn_counter_flat(pool_cluster):
+    """The headline adoption invariant: 50 actors created (in waves
+    sized to the pool, waiting for the async refill between waves)
+    with the cold-spawn fallback counter FLAT — every creation
+    adopted a prestarted worker."""
+    created = 0
+    before = _totals()
+    while created < 50:
+        _wait_idle(5, node_id=pool_cluster.head_node.node_id_hex)
+        wave = [Probe.remote() for _ in range(5)]
+        ray_tpu.get([a.ping.remote() for a in wave], timeout=120)
+        for a in wave:
+            ray_tpu.kill(a)
+        created += len(wave)
+    after = _totals()
+    assert after["cold_spawns"] - before["cold_spawns"] == 0
+    assert after["adoptions"] - before["adoptions"] >= 50
+
+
+@pytest.mark.slow
+def test_adoption_survives_agent_restart(pool_cluster):
+    """Kill the side agent (workers die with it), bring a replacement
+    node up: its pool prefills and creations adopt again."""
+    victim = pool_cluster.nodes[1]
+    pool_cluster.remove_node(victim)
+    fresh = pool_cluster.add_node(num_cpus=2,
+                                  resources={"side": 100})
+    pool_cluster.wait_for_nodes()
+    _wait_idle(5, node_id=fresh.node_id_hex)
+    before = _totals(node_id=fresh.node_id_hex)
+    actors = [SideProbe.remote() for _ in range(4)]
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=120)
+    after = _totals(node_id=fresh.node_id_hex)
+    assert after["cold_spawns"] - before["cold_spawns"] == 0
+    assert after["adoptions"] - before["adoptions"] >= 4
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_drain_kills_pool_and_refill_stays_quiet(pool_cluster):
+    """DRAINING integration: the drained agent kills its prestarted
+    idle workers immediately, reports draining in its pool books, and
+    the refill loop does NOT restock during the grace window.  Runs
+    last — a drain is one-way for the node."""
+    node = pool_cluster.nodes[-1]
+    _wait_idle(4, node_id=node.node_id_hex)
+    from ray_tpu.core import runtime as runtime_mod
+
+    rt = runtime_mod.get_runtime()
+    # if_idle (the autoscaler's reap mode) must SUCCEED despite the
+    # warm pool: prestarted idle workers are not leases and must never
+    # block an idle-node scale-down (the never-idle hazard).  Brief
+    # retry: a just-killed actor's lease release is asynchronous.
+    deadline = time.time() + 30.0
+    while True:
+        r = rt.controller_call("drain_node", {
+            "node_id": node.node_id_hex, "grace_s": 120.0,
+            "if_idle": True, "reason": "pool drain test"})
+        if r.get("ok") or time.time() > deadline:
+            break
+        time.sleep(0.3)
+    assert r.get("ok"), r
+    pool = _pools(node_id=node.node_id_hex)[0]
+    assert pool["draining"] is True
+    assert pool["idle"] == 0
+    # Several refill periods later the pool is still empty.
+    time.sleep(1.0)
+    pool = _pools(node_id=node.node_id_hex)[0]
+    assert pool["idle"] == 0
