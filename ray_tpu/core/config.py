@@ -252,6 +252,35 @@ define_flag("starvation_warn_s", float, 60.0,
             "its priority, and the jobs holding the contested "
             "resources (critical when the starved job outranks every "
             "holder).")
+define_flag("serve_request_timeout_s", float, 60.0,
+            "Default end-to-end deadline for one serve request "
+            "(proxy -> replica, spanning every failover retry).  The "
+            "ingress maps expiry to HTTP 504 / gRPC DEADLINE_EXCEEDED; "
+            "per-request override via the X-RT-Timeout-S header (HTTP) "
+            "or the timeout_s request field (gRPC).  0 = no deadline.")
+define_flag("serve_max_retries", int, 3,
+            "Transparent failover budget for a serve request that "
+            "fails with a SYSTEM fault (replica/worker death, lost "
+            "object) — the router re-routes it to a different healthy "
+            "replica within the request deadline.  User exceptions "
+            "are never retried.")
+define_flag("serve_max_queued", int, 100,
+            "Per-deployment admission queue bound at each handle/"
+            "ingress: requests beyond the replicas' concurrent "
+            "capacity wait here; when full the OLDEST queued request "
+            "is shed with HTTP 429 / gRPC RESOURCE_EXHAUSTED instead "
+            "of letting every request time out.  0 disables admission "
+            "control (dispatch-immediately).")
+define_flag("serve_breaker_failures", int, 3,
+            "Consecutive system-fault failures that trip a replica's "
+            "circuit breaker OPEN: the router stops sending it "
+            "traffic before the controller's health probe notices a "
+            "black-holed replica.")
+define_flag("serve_breaker_reset_s", float, 2.0,
+            "Base delay before an OPEN replica breaker admits one "
+            "half-open probe request; repeated trips back off "
+            "exponentially with jitter (the PR-4 RestartBackoff "
+            "schedule, capped at 30s).")
 define_flag("straggler_threshold", float, 0.2,
             "Straggler detector: a rank whose step time exceeds the "
             "per-step median by this fraction, sustained over the "

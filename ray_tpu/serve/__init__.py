@@ -14,6 +14,9 @@ from .controller import (CONTROLLER_NAME, DeploymentHandle,  # noqa
                          ServeController)
 from .deployment import (Application, AutoscalingConfig,  # noqa
                          Deployment, deployment)
+from .resilience import (ReplicasUnavailableError,  # noqa: F401
+                         RequestShedError, RequestTimeoutError,
+                         StreamInterruptedError)
 
 _http_proxy = None
 
@@ -77,7 +80,7 @@ def run(app: Application, *, name: str = "default",
         ray_tpu.get(ctl.deploy.remote(
             d.name, payload, args, kwargs, d.num_replicas,
             d.is_function, prefix, d.ray_actor_options, autoscaling,
-            streaming))
+            streaming, d.max_ongoing_requests))
         return DeploymentHandle(d.name)
 
     handle = deploy_app(app, True)

@@ -196,8 +196,10 @@ class ServeController:
                     timeout: float = 30.0) -> Dict[str, Any]:
         """Long-poll: blocks until the serve config is newer than
         ``known_version`` (or timeout), then returns the current
-        version, the named deployment's replicas, and the route table
-        (ref: long_poll.py LongPollHost.listen_for_change)."""
+        version, the named deployment's ROUTABLE replicas (a replica
+        bleeding off a draining node is already out of this list), and
+        the route table (ref: long_poll.py
+        LongPollHost.listen_for_change)."""
         deadline = time.time() + timeout
         with self._version_cond:
             while self._version <= known_version:
@@ -217,6 +219,10 @@ class ServeController:
                 # streaming call path BEFORE dispatch.
                 "streaming": {n: bool(e.get("streaming"))
                               for n, e in self.deployments.items()},
+                # Replica concurrency so handles size their admission
+                # gates (capacity = replicas x max_ongoing).
+                "max_ongoing": {n: int(e.get("max_ongoing", 16))
+                                for n, e in self.deployments.items()},
             }
 
     def deploy(self, name: str, cls_payload: bytes, init_args: tuple,
@@ -224,7 +230,8 @@ class ServeController:
                route_prefix: Optional[str],
                actor_options: Dict[str, Any],
                autoscaling: Optional[Dict[str, Any]] = None,
-               streaming: bool = False) -> bool:
+               streaming: bool = False,
+               max_ongoing: int = 16) -> bool:
         fresh = {
             "route_prefix": route_prefix,
             "target": num_replicas, "payload": cls_payload,
@@ -233,6 +240,7 @@ class ServeController:
             "actor_options": actor_options,
             "autoscaling": autoscaling,
             "streaming": streaming,
+            "max_ongoing": int(max_ongoing),
             "scale_up_since": None, "scale_down_since": None,
         }
         if autoscaling:
@@ -258,6 +266,14 @@ class ServeController:
     # ------------------------------------------------------- control loop
     def _control_loop(self) -> None:
         while not self._loop_stop.wait(1.0):
+            try:
+                # Bleed replicas off DRAINING nodes BEFORE the health
+                # pass: a drain notice must re-route traffic and spawn
+                # replacements on live nodes ahead of the eviction, not
+                # after the health probe finally sees the death.
+                self._bleed_draining_replicas()
+            except Exception:
+                pass
             for name in list(self.deployments):
                 try:
                     self._heal_and_autoscale(name)
@@ -265,6 +281,10 @@ class ServeController:
                     continue  # deleted mid-pass
                 except Exception:
                     pass  # next tick retries; the loop must survive
+            try:
+                self._publish_resilience()
+            except Exception:
+                pass
 
     @staticmethod
     def _batched_probe(refs: List[Any], timeout: float) -> List[Any]:
@@ -310,7 +330,8 @@ class ServeController:
                 return  # redeployed/deleted while probing; stale view
             for i, h in enumerate(health):
                 if isinstance(h, Exception):
-                    self.replace_dead_replica(name, i)
+                    self.replace_dead_replica(name, i,
+                                              reason="health_probe")
             counts = [v for v in ongoing
                       if not isinstance(v, Exception)]
             self._autoscale_locked(entry, name, counts)
@@ -343,7 +364,11 @@ class ServeController:
         cfg = entry.get("autoscaling")
         if not cfg or not ongoing:
             return
-        total = sum(ongoing)
+        # Demand = requests ON replicas + requests WAITING in handle/
+        # ingress admission queues (reported by the gates): a shedding
+        # deployment must read as overloaded even though its replicas'
+        # ongoing counts are capped at max_ongoing.
+        total = sum(ongoing) + self._queue_depth_locked(entry)
         import math
 
         desired = math.ceil(total / cfg["target_ongoing_requests"])
@@ -398,7 +423,8 @@ class ServeController:
             self.deployments[name]["target"] = num_replicas
             return self.reconcile(name)
 
-    def replace_dead_replica(self, name: str, index: int) -> bool:
+    def replace_dead_replica(self, name: str, index: int,
+                             reason: str = "dead") -> bool:
         with self._lock:
             entry = self.deployments.get(name)
             if entry is None or index >= len(entry["replicas"]):
@@ -415,8 +441,152 @@ class ServeController:
                 max_concurrency=32, **entry.get("actor_options", {}))
             entry["replicas"][index] = replica_cls.remote(
                 entry["payload"], args, kwargs, entry["is_function"])
+            self._log_replacement_locked(entry, index, reason)
             self._bump_version_locked()
             return True
+
+    # ------------------------------------------------ resilience plane
+    @staticmethod
+    def _log_replacement_locked(entry: Dict[str, Any], index: int,
+                                reason: str) -> None:
+        """Bounded per-deployment replacement log — the data behind
+        the doctor's crashloop finding (same index replaced again and
+        again means the deployment's own code or node is killing it,
+        not one unlucky replica)."""
+        log = entry.setdefault("replacements", [])
+        log.append({"index": index, "ts": time.time(),
+                    "reason": reason})
+        del log[:-256]
+
+    @staticmethod
+    def _queue_depth_locked(entry: Dict[str, Any],
+                            horizon_s: float = 5.0) -> int:
+        """Sum of fresh admission-queue depth reports from handles/
+        ingresses (stale reporters — a proxy that died — age out)."""
+        now = time.time()
+        reports = entry.get("queue_reports") or {}
+        for rep in [r for r, (_, ts) in reports.items()
+                    if now - ts > 60.0]:
+            del reports[rep]
+        return sum(depth for depth, ts in reports.values()
+                   if now - ts <= horizon_s)
+
+    def report_queue_depth(self, name: str, reporter: str,
+                           depth: int) -> None:
+        """Fire-and-forget from a handle's admission gate: how many
+        requests are WAITING at that reporter (feeds the request-based
+        autoscaler, which otherwise only sees on-replica load)."""
+        with self._lock:
+            entry = self.deployments.get(name)
+            if entry is not None:
+                entry.setdefault("queue_reports", {})[reporter] = (
+                    int(depth), time.time())
+
+    def report_breaker(self, name: str, replica_key: str, state: str,
+                       reporter: str = "") -> None:
+        """Fire-and-forget from a handle's breaker board on every
+        trip/close transition; the doctor's open-circuit finding and
+        `rt telemetry` read the merged view here."""
+        with self._lock:
+            entry = self.deployments.get(name)
+            if entry is not None:
+                entry.setdefault("breaker_reports", {})[replica_key] = {
+                    "state": state, "ts": time.time(),
+                    "reporter": reporter}
+
+    def _bleed_draining_replicas(self) -> None:
+        """Replica bleed-off on drain (the roadmap's drain-aware
+        scale-down): a replica hosted on a DRAINING node (preemption
+        notice / `rt drain`) is pulled out of the routable set NOW —
+        handles stop routing to it on the next config push — while the
+        actor itself keeps running to finish in-flight requests (the
+        existing drain-reap loop kills it once idle), and reconcile()
+        immediately spawns its replacement, which lands on a live node
+        because draining agents refuse lease grants."""
+        if not self.deployments:
+            return  # nothing to bleed; skip the per-tick cluster RPC
+        try:
+            from ..util import state as state_api
+
+            nodes = state_api.list_nodes()
+        except Exception:
+            return  # local mode / controller unreachable: nothing to do
+        draining = {n.get("node_id") for n in nodes
+                    if n.get("alive") and n.get("draining")}
+        if not draining:
+            return
+        try:
+            actors = state_api.list_actors()
+        except Exception:
+            return
+        node_of = {a.get("actor_id"): a.get("node_id") for a in actors}
+        with self._lock:
+            for name in list(self.deployments):
+                entry = self.deployments[name]
+                keep, bled = [], []
+                for i, r in enumerate(entry["replicas"]):
+                    nid = node_of.get(r.actor_id.hex())
+                    if nid and nid in draining:
+                        bled.append((i, r))
+                    else:
+                        keep.append(r)
+                if not bled:
+                    continue
+                for i, r in bled:
+                    entry.setdefault("draining", []).append(
+                        (r, time.time(), r.ongoing.remote()))
+                    self._log_replacement_locked(entry, i,
+                                                 "drain_bleed")
+                entry["replicas"] = keep
+                entry["gen"] += 1  # invalidate in-flight probe passes
+                self.reconcile(name)
+
+    def resilience_stats(self) -> Dict[str, Any]:
+        """Plain-dict view of the resilience plane per deployment —
+        consumed by `rt doctor` (crashloop / open-circuit findings),
+        `rt telemetry`, and the chaos acceptance test."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for name, e in self.deployments.items():
+                # Prune breaker reports for replicas that left the
+                # routable set (replaced or bled off): a dead
+                # replica's OPEN report is moot and must not read as
+                # a black-holed live replica in `rt doctor`.
+                live = {r.actor_id.hex() for r in e["replicas"]}
+                reports = e.get("breaker_reports") or {}
+                for key in [k for k in reports if k not in live]:
+                    del reports[key]
+                out[name] = {
+                    "replicas": len(e["replicas"]),
+                    "target": e["target"],
+                    "draining": len(e.get("draining", [])),
+                    "replacements": list(e.get("replacements", [])),
+                    "breakers": {k: dict(v)
+                                 for k, v in reports.items()},
+                    "queue_depth": self._queue_depth_locked(e),
+                }
+        return out
+
+    def _publish_resilience(self) -> None:
+        """Mirror resilience_stats into the cluster controller's KV
+        (key ``serve/resilience``) on the control-loop cadence, so the
+        doctor/telemetry CLIs read it over the plain controller RPC
+        without needing the actor-call machinery."""
+        import json as _json
+
+        now = time.time()
+        if now - getattr(self, "_resil_pub_ts", 0.0) < 2.0:
+            return
+        self._resil_pub_ts = now
+        stats = self.resilience_stats()
+        if not stats:
+            return
+        from ..util import state as state_api
+
+        state_api._call("kv_put", {
+            "key": "serve/resilience",
+            "value": _json.dumps({"ts": now, "deployments": stats},
+                                 default=repr).encode()})
 
     def get_replicas(self, name: str) -> List[Any]:
         with self._lock:
@@ -466,7 +636,11 @@ class DeploymentHandle:
     """
 
     def __init__(self, deployment_name: str):
+        import os
         import threading
+
+        from ..core.config import RuntimeConfig
+        from .resilience import AdmissionGate, BreakerBoard
 
         self.deployment_name = deployment_name
         self._replicas: List[Any] = []
@@ -476,9 +650,82 @@ class DeploymentHandle:
         self._lock = threading.Lock()
         self._have_replicas = threading.Event()
         self._poller: Optional[threading.Thread] = None
+        # --- resilience plane (config snapshot at handle creation)
+        cfg = RuntimeConfig.from_env()
+        self._timeout_s = cfg.serve_request_timeout_s
+        self._max_retries = max(0, int(cfg.serve_max_retries))
+        self._max_ongoing = 16
+        self._reporter = f"{os.getpid():x}.{id(self) & 0xffffff:x}"
+        self._breakers = BreakerBoard(
+            failure_threshold=cfg.serve_breaker_failures,
+            reset_s=cfg.serve_breaker_reset_s,
+            on_transition=self._on_breaker_transition)
+        self._gate = AdmissionGate(
+            cfg.serve_max_queued,
+            capacity=lambda: len(self._replicas) * self._max_ongoing,
+            on_depth_change=self._on_queue_depth)
+        self._depth_report = (0, 0.0)   # (last depth, last report ts)
 
     def _controller(self):
         return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    # ------------------------------------------------- observability
+    def _counter(self, name: str, doc: str):
+        from ..util.metrics import Counter
+
+        return Counter(name, doc, tag_keys=("deployment",))
+
+    def _inc(self, name: str, doc: str) -> None:
+        try:
+            self._counter(name, doc).inc(
+                tags={"deployment": self.deployment_name})
+        except Exception:
+            pass
+
+    def _on_breaker_transition(self, key: str, state: str) -> None:
+        """Breaker trip/close: export the per-replica state gauge and
+        tell the serve controller (fire-and-forget) so `rt doctor` /
+        `rt telemetry` see circuits opened by ANY handle."""
+        try:
+            from ..util.metrics import Gauge
+
+            Gauge("rt_serve_breaker_open",
+                  "Per-replica circuit state (1 open, 0 closed).",
+                  tag_keys=("deployment", "replica")).set(
+                1.0 if state == "open" else 0.0,
+                tags={"deployment": self.deployment_name,
+                      "replica": key[:12]})
+        except Exception:
+            pass
+        try:
+            self._controller().report_breaker.remote(
+                self.deployment_name, key, state, self._reporter)
+        except Exception:
+            pass
+
+    def _on_queue_depth(self, depth: int) -> None:
+        try:
+            from ..util.metrics import Gauge
+
+            Gauge("rt_serve_queue_depth",
+                  "Requests waiting in the admission queue.",
+                  tag_keys=("deployment",)).set(
+                float(depth),
+                tags={"deployment": self.deployment_name})
+        except Exception:
+            pass
+        # Throttled fire-and-forget to the autoscaler: report depth
+        # changes at most ~2/s, plus the return-to-zero edge.
+        last_depth, last_ts = self._depth_report
+        now = time.time()
+        if depth != last_depth and (now - last_ts >= 0.5 or
+                                    (depth == 0) != (last_depth == 0)):
+            self._depth_report = (depth, now)
+            try:
+                self._controller().report_queue_depth.remote(
+                    self.deployment_name, self._reporter, depth)
+            except Exception:
+                pass
 
     # ------------------------------------------------------- config push
     def _apply_update(self, r: Dict[str, Any]) -> None:
@@ -487,10 +734,20 @@ class DeploymentHandle:
             self._replicas = list(r["replicas"])
             self._streaming = bool(
                 r.get("streaming", {}).get(self.deployment_name))
+            self._max_ongoing = int(
+                r.get("max_ongoing", {}).get(self.deployment_name,
+                                             self._max_ongoing))
             live = {rep.actor_id.hex() for rep in self._replicas}
             for key in list(self._inflight):
                 if key not in live:
                     del self._inflight[key]
+        # A replaced replica's failure history must not poison the
+        # fresh actor that takes its slot (new actor = new key) — and
+        # a pruned OPEN breaker must retire its gauge/report, or the
+        # dead replica reads as black-holed forever in telemetry.
+        for key, state in self._breakers.prune(live):
+            if state != "closed":
+                self._on_breaker_transition(key, "closed")
         if self._replicas:
             self._have_replicas.set()
         else:
@@ -527,34 +784,70 @@ class DeploymentHandle:
                 f"deployment {self.deployment_name!r} has no replicas")
 
     # ----------------------------------------------------------- routing
-    def _pick(self):
-        """Two random candidates, lower LOCAL in-flight count wins —
-        no RPC on the dispatch path."""
+    def _pick(self, exclude=(), strict: bool = False):
+        """Breaker-aware power-of-two-choices over LOCAL in-flight
+        counts — no RPC on the dispatch path.  ``exclude`` skips
+        replicas already tried by this request's failover loop.  With
+        ``strict`` every candidate must pass its circuit breaker
+        (``ReplicasUnavailableError`` otherwise — the resilient call
+        path); without it a fully-blocked board falls back to legacy
+        pow-2 so ``remote()`` keeps its fire-and-forget contract."""
+        from .resilience import ReplicasUnavailableError, select_replica
+
         self._ensure_fresh()
         with self._lock:
-            if not self._replicas:
-                raise RuntimeError(
-                    f"deployment {self.deployment_name!r} has no "
-                    "replicas")
-            if len(self._replicas) == 1:
-                chosen = self._replicas[0]
+            replicas = list(self._replicas)
+            inflight = dict(self._inflight)
+        if not replicas:
+            raise RuntimeError(
+                f"deployment {self.deployment_name!r} has no "
+                "replicas")
+        sel = select_replica(replicas, self._breakers, inflight,
+                             exclude=exclude)
+        if sel is None and exclude:
+            # Every replica was already tried: retry budget outlives
+            # the replica count, so re-admit previously-tried ones
+            # (a replacement may have taken a failed one's slot).
+            sel = select_replica(replicas, self._breakers, inflight)
+        if sel is None:
+            if strict:
+                raise ReplicasUnavailableError(
+                    self.deployment_name,
+                    f"all {len(replicas)} replica breaker(s) open")
+            # Legacy path: ignore breakers rather than fail a plain
+            # .remote() dispatch.
+            if len(replicas) == 1:
+                chosen = replicas[0]
             else:
-                a, b = random.sample(self._replicas, 2)
-                qa = self._inflight.get(a.actor_id.hex(), 0)
-                qb = self._inflight.get(b.actor_id.hex(), 0)
+                a, b = random.sample(replicas, 2)
+                qa = inflight.get(a.actor_id.hex(), 0)
+                qb = inflight.get(b.actor_id.hex(), 0)
                 chosen = a if qa <= qb else b
-            key = chosen.actor_id.hex()
+            sel = (chosen, chosen.actor_id.hex())
+        chosen, key = sel
+        with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
         return chosen, key
 
     def _track(self, ref, key: str):
-        def _done(_fut):
-            with self._lock:
-                n = self._inflight.get(key, 0) - 1
-                if n > 0:
-                    self._inflight[key] = n
-                else:
-                    self._inflight.pop(key, None)
+        from .resilience import is_system_fault
+
+        def _done(fut):
+            self._release_inflight(key)
+            # Passive breaker feed: EVERY dispatched request reports
+            # its outcome, so plain .remote() traffic trips/heals
+            # breakers too.  A user exception means the replica is
+            # alive and working — that's a success signal.
+            if fut is None:
+                return
+            try:
+                exc = fut.exception()
+            except Exception:
+                return
+            if exc is not None and is_system_fault(exc):
+                self._breakers.record_failure(key)
+            else:
+                self._breakers.record_success(key)
 
         try:
             ref.future().add_done_callback(_done)
@@ -566,6 +859,85 @@ class DeploymentHandle:
         replica, key = self._pick()
         return self._track(replica.handle_request.remote(args, kwargs),
                            key)
+
+    # ------------------------------------------------- resilient call
+    def call(self, *args, timeout_s: Optional[float] = None,
+             **kwargs):
+        """Resilient unary call: admission control, one deadline
+        spanning everything, and transparent failover — a dispatch
+        that dies with a SYSTEM fault (replica/worker death, lost
+        result; never a user exception) is re-routed to a different
+        healthy replica up to ``serve_max_retries`` times within the
+        deadline.  Blocks until the result; raises
+        ``RequestShedError`` / ``RequestTimeoutError`` /
+        ``ReplicasUnavailableError`` (the ingress maps them to
+        429/504/503) or the handler's own exception."""
+        from ..core.errors import GetTimeoutError
+        from .resilience import (Deadline, RequestShedError,
+                                 RequestTimeoutError, is_system_fault)
+
+        deadline = Deadline(self._timeout_s if timeout_s is None
+                            else timeout_s)
+        self._ensure_fresh()
+        try:
+            admission = self._gate.admit(deadline,
+                                         self.deployment_name)
+        except RequestShedError:
+            self._inc("rt_serve_shed_total",
+                      "Serve requests shed by admission control.")
+            raise
+        except RequestTimeoutError:
+            # Expired while WAITING in the admission queue.
+            self._inc("rt_serve_deadline_exceeded_total",
+                      "Serve requests that exceeded their deadline.")
+            raise
+        with admission:
+            tried: set = set()
+            last_fault: Optional[BaseException] = None
+            for attempt in range(self._max_retries + 1):
+                if deadline.expired:
+                    break
+                replica, key = self._pick(exclude=tried, strict=True)
+                ref = self._track(
+                    replica.handle_request.remote(args, kwargs), key)
+                try:
+                    return ray_tpu.get(
+                        ref, timeout=deadline.remaining(cap=3600.0))
+                except GetTimeoutError:
+                    # Budget exhausted mid-flight: stop the replica-
+                    # side work and surface 504, not a retry (the
+                    # client's deadline is gone either way).
+                    try:
+                        ray_tpu.cancel(ref)
+                    except Exception:
+                        pass
+                    # A timed-out HALF-OPEN probe must not wedge the
+                    # breaker with its slot consumed forever.
+                    if self._breakers.state(key) != "closed":
+                        self._breakers.record_failure(key)
+                    self._inc("rt_serve_deadline_exceeded_total",
+                              "Serve requests that exceeded their "
+                              "deadline.")
+                    raise RequestTimeoutError(
+                        self.deployment_name, deadline.timeout_s)
+                except Exception as e:  # noqa: BLE001
+                    if not is_system_fault(e):
+                        raise  # the handler's own error: never retried
+                    # _track's done-callback already fed the breaker.
+                    last_fault = e
+                    tried.add(key)
+                    if attempt < self._max_retries:
+                        self._inc("rt_serve_retries_total",
+                                  "Serve requests transparently "
+                                  "re-routed after a system fault.")
+                    continue
+            if deadline.expired:
+                self._inc("rt_serve_deadline_exceeded_total",
+                          "Serve requests that exceeded their "
+                          "deadline.")
+                raise RequestTimeoutError(self.deployment_name,
+                                          deadline.timeout_s)
+            raise last_fault  # retries exhausted on system faults
 
     def replica_by_key(self, key: str):
         """Resolve a replica handle by actor-id hex (stream affinity:
@@ -603,21 +975,116 @@ class DeploymentHandle:
         """Call a deployment through the streaming path; yields items
         as the replica produces them over the core ObjectRefGenerator
         plane — no chunk polling (ref: handle.options(stream=True)).
-        Unary handlers yield exactly one item."""
-        gen, release = self.stream_refs(*args, **kwargs)
-        try:
-            for ref in gen:
-                yield ray_tpu.get(ref, timeout=120)
-        except BaseException:
-            # Abandoned or failed consumer: stop the producer now,
-            # not at generator GC time.
+        Unary handlers yield exactly one item.
+
+        Resilience semantics: a stream that dies from a SYSTEM fault
+        BEFORE its first item is transparently retried on another
+        replica (like a unary call, within the deadline); after the
+        first item a system fault surfaces as the TYPED
+        ``StreamInterruptedError`` — consumers can always distinguish
+        an interrupted stream from a completed one.  The handler's own
+        exceptions pass through unchanged, and the deadline bounds
+        dispatch + time-to-first-item (not total stream life)."""
+        return self._stream_impl(args, kwargs, self._timeout_s)
+
+    def stream_timed(self, timeout_s: Optional[float], *args,
+                     **kwargs):
+        """``stream()`` with a per-request deadline override (the
+        ingress propagation path)."""
+        return self._stream_impl(
+            args, kwargs,
+            self._timeout_s if timeout_s is None else timeout_s)
+
+    def _stream_impl(self, args: tuple, kwargs: dict,
+                     timeout_s: float):
+        from ..core.errors import GetTimeoutError
+        from .resilience import (Deadline, RequestTimeoutError,
+                                 StreamInterruptedError,
+                                 is_system_fault)
+
+        deadline = Deadline(timeout_s)
+        # Idle bound between items: streams live as long as frames
+        # keep coming; the request deadline only governs the dispatch
+        # + first-frame window (time-to-first-token, for generation).
+        item_timeout = max(timeout_s or 0.0, 120.0)
+        tried: set = set()
+        for attempt in range(self._max_retries + 1):
+            replica, key = self._pick(exclude=tried, strict=True)
+            gen = replica.handle_request_stream.options(
+                num_returns="streaming").remote(args, kwargs)
+            delivered = 0
             try:
-                ray_tpu.cancel(gen)
-            except Exception:
-                pass
-            raise
-        finally:
-            release()
+                for ref in gen:
+                    timeout = (deadline.remaining(cap=item_timeout)
+                               if delivered == 0 and deadline.bounded
+                               else item_timeout)
+                    item = ray_tpu.get(ref, timeout=timeout)
+                    delivered += 1
+                    yield item
+                self._breakers.record_success(key)
+                return
+            except GeneratorExit:
+                # Abandoned consumer: stop the producer now, not at
+                # generator GC time.
+                try:
+                    ray_tpu.cancel(gen)
+                except Exception:
+                    pass
+                raise
+            except GetTimeoutError as e:
+                # Deadline (first frame) or idle bound (later frames)
+                # expired: stop the producer and surface typed.
+                try:
+                    ray_tpu.cancel(gen)
+                except Exception:
+                    pass
+                if self._breakers.state(key) != "closed":
+                    self._breakers.record_failure(key)
+                self._inc("rt_serve_deadline_exceeded_total",
+                          "Serve requests that exceeded their "
+                          "deadline.")
+                if delivered == 0:
+                    raise RequestTimeoutError(self.deployment_name,
+                                              deadline.timeout_s)
+                raise StreamInterruptedError(
+                    self.deployment_name, repr(e), delivered) from e
+            except Exception as e:  # noqa: BLE001
+                if not is_system_fault(e):
+                    # The handler's own error: the replica is alive
+                    # and responding — a success signal breaker-wise.
+                    self._breakers.record_success(key)
+                    try:
+                        ray_tpu.cancel(gen)
+                    except Exception:
+                        pass
+                    raise
+                self._breakers.record_failure(key)
+                tried.add(key)
+                if delivered == 0:
+                    if attempt < self._max_retries and \
+                            not deadline.expired:
+                        # Died before the first frame: retry like
+                        # unary.
+                        self._inc("rt_serve_retries_total",
+                                  "Serve requests transparently "
+                                  "re-routed after a system fault.")
+                        continue
+                    # Retries exhausted with nothing delivered: this
+                    # is a plain system fault (ingresses map it to
+                    # 503/UNAVAILABLE), not an interrupted stream.
+                    raise
+                raise StreamInterruptedError(
+                    self.deployment_name, repr(e), delivered) from e
+            finally:
+                self._release_inflight(key)
+
+    def _release_inflight(self, key: str) -> None:
+        with self._lock:
+            n = self._inflight.get(key, 0) - 1
+            if n > 0:
+                self._inflight[key] = n
+            else:
+                self._inflight.pop(key, None)
 
     def method(self, method_name: str):
         handle = self

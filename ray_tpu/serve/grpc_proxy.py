@@ -54,44 +54,111 @@ class GRPCProxy:
                 h = self._handles[name] = DeploymentHandle(name)
             return h
 
-        def call(request: bytes, context) -> bytes:
+        def _timeout_of(req: Dict[str, Any], context) -> Any:
+            """Per-request deadline: explicit ``timeout_s`` request
+            field wins; else the client's own gRPC deadline (so the
+            server stops working on a call the client already gave up
+            on); else the ``serve_request_timeout_s`` default."""
+            t = req.get("timeout_s")
+            if t is not None:
+                try:
+                    return max(0.0, float(t))
+                except (TypeError, ValueError):
+                    pass
+            try:
+                remaining = context.time_remaining()
+            except Exception:
+                remaining = None
+            # A channel without a deadline reports None (or a huge
+            # sentinel); only propagate real client deadlines.
+            if remaining is not None and remaining < 3e7:
+                return max(0.0, float(remaining))
+            return None
+
+        def _abort_typed(context, e: BaseException) -> None:
+            """Map resilience-plane errors to the canonical gRPC
+            status codes (ref: the reference's gRPC proxy surfacing
+            DEADLINE_EXCEEDED / RESOURCE_EXHAUSTED / UNAVAILABLE)."""
             import grpc as _grpc
 
-            import ray_tpu
+            from .controller import StreamingResponseRequired
+            from .resilience import (ReplicasUnavailableError,
+                                     RequestShedError,
+                                     RequestTimeoutError,
+                                     is_system_fault)
+
+            if isinstance(e, RequestShedError):
+                context.abort(_grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              repr(e))
+            if isinstance(e, RequestTimeoutError):
+                context.abort(_grpc.StatusCode.DEADLINE_EXCEEDED,
+                              repr(e))
+            if isinstance(e, ReplicasUnavailableError) or \
+                    is_system_fault(e):
+                context.abort(_grpc.StatusCode.UNAVAILABLE, repr(e))
+            cause = getattr(e, "cause", None) or \
+                getattr(e, "__cause__", None) or e
+            if isinstance(cause, StreamingResponseRequired) or \
+                    "StreamingResponseRequired" in repr(e):
+                context.abort(
+                    _grpc.StatusCode.INVALID_ARGUMENT,
+                    "deployment streams; use "
+                    "/ray_tpu.serve.Ingress/CallStream")
+            context.abort(_grpc.StatusCode.INTERNAL, repr(e))
+
+        def call(request: bytes, context) -> bytes:
+            import grpc as _grpc
 
             try:
                 req = json.loads(request or b"{}")
                 handle = _handle_for(_resolve(req))
-                result = ray_tpu.get(handle.remote(req.get("payload")),
-                                     timeout=60)
+                result = handle.call(req.get("payload"),
+                                     timeout_s=_timeout_of(req,
+                                                           context))
             except KeyError as e:
                 context.abort(_grpc.StatusCode.NOT_FOUND, str(e))
             except Exception as e:  # noqa: BLE001 — surface to client
-                from .controller import StreamingResponseRequired
-
-                cause = getattr(e, "cause", None) or \
-                    getattr(e, "__cause__", None) or e
-                if isinstance(cause, StreamingResponseRequired) or \
-                    "StreamingResponseRequired" in repr(e):
-                    context.abort(
-                        _grpc.StatusCode.INVALID_ARGUMENT,
-                        "deployment streams; use "
-                        "/ray_tpu.serve.Ingress/CallStream")
-                context.abort(_grpc.StatusCode.INTERNAL, repr(e))
+                _abort_typed(context, e)
             return json.dumps({"result": result}).encode()
 
         def call_stream(request: bytes, context):
             import grpc as _grpc
 
+            from .resilience import (StreamInterruptedError,
+                                     is_system_fault)
+
+            delivered = 0
             try:
                 req = json.loads(request or b"{}")
                 handle = _handle_for(_resolve(req))
-                for item in handle.stream(req.get("payload")):
+                for item in handle.stream_timed(
+                        _timeout_of(req, context),
+                        req.get("payload")):
+                    delivered += 1
                     yield json.dumps(item).encode()
             except KeyError as e:
                 context.abort(_grpc.StatusCode.NOT_FOUND, str(e))
             except Exception as e:  # noqa: BLE001
-                context.abort(_grpc.StatusCode.INTERNAL, repr(e))
+                if delivered == 0:
+                    _abort_typed(context, e)
+                # Mid-stream failure: the typed trailer is how a gRPC
+                # consumer distinguishes an interrupted stream from a
+                # completed one (items already went out, but abort()
+                # still carries status + trailing metadata).
+                info = {"type": type(e).__name__,
+                        "message": str(e) or repr(e),
+                        "system": bool(
+                            is_system_fault(e) or
+                            isinstance(e, StreamInterruptedError)),
+                        "items_delivered": delivered}
+                try:
+                    context.set_trailing_metadata((
+                        ("rt-stream-error", json.dumps(info)),))
+                except Exception:
+                    pass
+                code = (_grpc.StatusCode.UNAVAILABLE if info["system"]
+                        else _grpc.StatusCode.INTERNAL)
+                context.abort(code, repr(e))
 
         ident = lambda b: b  # noqa: E731 — raw-bytes (de)serializer
         handlers = grpc.method_handlers_generic_handler(SERVICE, {

@@ -75,6 +75,7 @@ class HTTPProxy:
 
     def __init__(self, port: int = 0):
         import asyncio
+        from concurrent.futures import ThreadPoolExecutor
 
         from aiohttp import web
 
@@ -86,10 +87,67 @@ class HTTPProxy:
         self._actual_port = None
         self._ready = threading.Event()
         self._telemetry = _IngressTelemetry()
+        # Dedicated executor for the blocking handle calls: the
+        # default loop executor sizes to ~cpu+4 threads, which on a
+        # small host caps concurrent in-flight requests BELOW the
+        # admission gate's queue bound — overload would then pile up
+        # invisibly in the executor instead of shedding with 429.
+        self._executor = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="serve-proxy")
+        from ..core.config import RuntimeConfig
+
+        self._default_timeout = RuntimeConfig.from_env(
+        ).serve_request_timeout_s
+
+        def _error_status(e: BaseException) -> int:
+            """Resilience-plane errors map to meaningful statuses —
+            the pre-resilience proxy surfaced every failure as a
+            generic 500."""
+            from .resilience import (ReplicasUnavailableError,
+                                     RequestShedError,
+                                     RequestTimeoutError,
+                                     is_system_fault)
+
+            if isinstance(e, RequestShedError):
+                return 429    # admission queue full, oldest shed
+            if isinstance(e, RequestTimeoutError):
+                return 504    # request deadline exceeded
+            if isinstance(e, ReplicasUnavailableError) or \
+                    is_system_fault(e):
+                return 503    # no healthy replica (even after retries)
+            return 500
+
+        def _stream_error_chunk(e: BaseException) -> bytes:
+            """Structured terminal error frame: status 200 already
+            went out, so this line is the ONLY way a consumer can
+            distinguish a mid-stream failure from completion."""
+            from .resilience import (StreamInterruptedError,
+                                     is_system_fault)
+
+            info: Dict[str, Any] = {
+                "type": type(e).__name__,
+                "message": str(e) or repr(e),
+                "system": bool(is_system_fault(e) or
+                               isinstance(e, StreamInterruptedError)),
+            }
+            if isinstance(e, StreamInterruptedError):
+                info["items_delivered"] = e.items_delivered
+            return (json.dumps({"__rt_stream_error__": info})
+                    + "\n").encode()
+
+        def _request_timeout(request: "web.Request") -> Optional[float]:
+            """Per-request deadline override (X-RT-Timeout-S header);
+            None falls through to ``serve_request_timeout_s``."""
+            raw = request.headers.get("X-RT-Timeout-S")
+            if not raw:
+                return None
+            try:
+                return max(0.0, float(raw))
+            except ValueError:
+                return None
 
         async def _handle(request: "web.Request",
                           tel: Dict[str, str]) -> "web.Response":
-            import ray_tpu
             from .controller import DeploymentHandle
 
             path = "/" + request.match_info.get("tail", "")
@@ -108,55 +166,119 @@ class HTTPProxy:
             handle = self._routes.get(target)
             if handle is None:
                 handle = self._routes[target] = DeploymentHandle(target)
+            timeout_s = _request_timeout(request)
+            # The effective deadline also bounds the EXECUTOR hop: a
+            # request parked in the thread pool's internal queue has
+            # not started its Deadline yet, so under saturation it
+            # would otherwise wait unboundedly with no 429/504 —
+            # asyncio.wait_for makes the client-side deadline hold no
+            # matter where the request is stuck (+grace so an
+            # in-flight call that is ABOUT to 504 itself wins the
+            # race and returns the richer error).
+            eff_timeout = (self._default_timeout if timeout_s is None
+                           else timeout_s)
             loop = asyncio.get_event_loop()
+
+            async def _bounded(fut):
+                if eff_timeout and eff_timeout > 0:
+                    return await asyncio.wait_for(
+                        fut, timeout=eff_timeout + 1.0)
+                return await fut
+
+            from .resilience import RequestTimeoutError
+
             if self._route_table.is_streaming(target):
                 # Generator deployment: chunked ndjson written as the
                 # replica yields, carried by the core streaming-
-                # generator plane — the proxy holds an
-                # ObjectRefGenerator, there is NO replica chunk-poll
-                # protocol anymore (ref: proxy.py:763 streaming
-                # responses; round-4 VERDICT weak #6).
-                gen, release = await loop.run_in_executor(
-                    None, lambda: handle.stream_refs(payload))
+                # generator plane through the handle's RESILIENT
+                # stream — a stream that dies before its first frame
+                # is retried on another replica like a unary call, so
+                # the first-frame pull happens BEFORE the 200 goes
+                # out and pre-stream failures get real status codes.
+                it = handle.stream_timed(timeout_s, payload)
+                _END = object()
+
+                def _next():
+                    try:
+                        return next(it)
+                    except StopIteration:
+                        return _END
+
+                def _close_after(fut):
+                    # The generator may be mid-next() in the executor
+                    # thread: close() would raise "generator already
+                    # executing", silently leaking the replica-side
+                    # stream.  Close when the in-flight step returns.
+                    def _do_close(_f):
+                        try:
+                            it.close()
+                        except Exception:
+                            pass
+
+                    try:
+                        fut.add_done_callback(_do_close)
+                    except Exception:
+                        _do_close(None)
+
+                step = loop.run_in_executor(self._executor, _next)
+                try:
+                    first = await _bounded(step)
+                except asyncio.TimeoutError:
+                    _close_after(step)
+                    return web.json_response(
+                        {"error": repr(RequestTimeoutError(
+                            target, eff_timeout))}, status=504)
+                except asyncio.CancelledError:
+                    _close_after(step)
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    return web.json_response(
+                        {"error": repr(e)}, status=_error_status(e))
                 resp = web.StreamResponse()
                 resp.content_type = "application/x-ndjson"
                 await resp.prepare(request)
-                finished = False
+                step = None
                 try:
-                    async for ref in gen:
-                        try:
-                            item = await loop.run_in_executor(
-                                None, lambda r=ref: ray_tpu.get(
-                                    r, timeout=60))
-                        except Exception as e:  # noqa: BLE001
-                            # Mid-stream failure: status already went
-                            # out — emit an explicit trailer line so
-                            # clients can distinguish truncation from
-                            # completion.
-                            await resp.write((json.dumps(
-                                {"__rt_stream_error__": repr(e)})
-                                + "\n").encode())
-                            finished = True
-                            break
+                    item = first
+                    while item is not _END:
                         await resp.write(
                             (json.dumps(item) + "\n").encode())
-                    else:
-                        finished = True
-                    await resp.write_eof()
-                finally:
-                    release()
-                    if not finished:
-                        # Client went away mid-stream: stop the
-                        # replica-side generator now.
+                        step = loop.run_in_executor(self._executor,
+                                                    _next)
                         try:
-                            ray_tpu.cancel(gen)
+                            item = await step
+                        except Exception as e:  # noqa: BLE001
+                            # Mid-stream failure: emit the typed
+                            # terminal frame so consumers never
+                            # mistake truncation for completion.
+                            await resp.write(_stream_error_chunk(e))
+                            break
+                    await resp.write_eof()
+                except (ConnectionError, asyncio.CancelledError):
+                    # Client went away mid-stream: stop the replica-
+                    # side generator as soon as the in-flight step
+                    # (if any) hands the generator back.
+                    if step is not None:
+                        _close_after(step)
+                    else:
+                        try:
+                            it.close()
                         except Exception:
                             pass
+                    raise
                 return resp
-            ref = await loop.run_in_executor(
-                None, lambda: handle.remote(payload))
-            result = await loop.run_in_executor(
-                None, lambda: ray_tpu.get(ref, timeout=60))
+            call_fut = loop.run_in_executor(
+                self._executor,
+                lambda: handle.call(payload, timeout_s=timeout_s))
+            try:
+                result = await _bounded(call_fut)
+            except asyncio.TimeoutError:
+                return web.json_response(
+                    {"error": repr(RequestTimeoutError(
+                        target, eff_timeout))}, status=504)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response(
+                    {"error": repr(e)}, status=_error_status(e))
             if isinstance(result, (dict, list, str, int, float, bool,
                                    type(None))):
                 return web.json_response({"result": result})

@@ -6,4 +6,5 @@ NodeKillerBase / WorkerKillerActor).
 """
 
 from .chaos import (NodeKiller, PreemptionKiller,  # noqa
-                    WorkerKiller, preempt_node_processes)
+                    ReplicaKiller, WorkerKiller,
+                    preempt_node_processes)

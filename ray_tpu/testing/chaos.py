@@ -160,6 +160,77 @@ def preempt_node_processes(node, grace_s: float,
         pass
 
 
+def _controller_call(address: str, method: str, payload=None):
+    import asyncio
+
+    from ..core.rpc import RpcClient
+
+    async def _go():
+        cli = RpcClient(address, connect_timeout=5.0)
+        try:
+            return await cli.call(method, payload or {})
+        finally:
+            await cli.close()
+
+    return asyncio.run(_go())
+
+
+class ReplicaKiller(_KillerThread):
+    """SIGKILLs a random SERVE REPLICA worker by pid — the chaos the
+    request-resilience plane exists for (failover retries + circuit
+    breakers must absorb the death before the serve controller's
+    health probe replaces the actor).  Replica workers are found by
+    cross-referencing the controller's actor table (class ``_Replica``)
+    with each node agent's worker inventory, exactly the processes a
+    crashing model server would take out (ref: WorkerKillerActor, but
+    aimed at serve replicas specifically)."""
+
+    def __init__(self, cluster, interval_s: float = 2.0, seed: int = 0,
+                 max_kills: int = 0):
+        super().__init__(interval_s, seed, max_kills)
+        self._cluster = cluster
+
+    def _replica_actor_ids(self) -> set:
+        actors = _controller_call(self._cluster.address,
+                                  "list_actors") or []
+        out = set()
+        for a in actors:
+            if a.get("class_name") == "_Replica":
+                aid = a.get("actor_id")
+                out.add(aid.hex() if hasattr(aid, "hex") else str(aid))
+        return out
+
+    def _pick(self) -> Optional[int]:
+        replicas = self._replica_actor_ids()
+        if not replicas:
+            return None
+        pids: List[int] = []
+        for node in self._cluster.nodes:
+            if node.proc.poll() is not None:
+                continue
+            try:
+                import asyncio
+
+                from ..core.rpc import RpcClient
+
+                async def _go(addr=node.agent_addr):
+                    cli = RpcClient(addr, connect_timeout=5.0)
+                    try:
+                        return await cli.call("list_workers", {})
+                    finally:
+                        await cli.close()
+
+                info = asyncio.run(_go())
+            except Exception:
+                continue
+            for w in info.get("workers", []):
+                if w.get("actor_id") in replicas:
+                    pids.append(w["pid"])
+        if not pids:
+            return None
+        return self._rng.choice(pids)
+
+
 class WorkerKiller(_KillerThread):
     """Kills a random live worker process of the given agents (ref:
     WorkerKillerActor — kills the process executing a task, exercising

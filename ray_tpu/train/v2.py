@@ -12,9 +12,8 @@ failure domain; per-worker patching is not meaningful under SPMD).
 
 from __future__ import annotations
 
-import random
 import time
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional
 
@@ -135,49 +134,10 @@ class FailurePolicy:
                 else FailureDecision.RAISE)
 
 
-@dataclass
-class RestartBackoff:
-    """Jittered exponential delay between gang restart attempts.
-
-    The pre-drain-plane controller hot-looped: teardown -> reschedule
-    -> fail -> teardown, burning scheduler/API cycles during incidents
-    and synchronizing every driver's retries after a fleet-wide
-    preemption wave.  delay(n) = min(max_s, base_s * multiplier**n),
-    scaled by a uniform factor in [1-jitter, 1+jitter].  ``reset()``
-    after a successful (or long-lived) attempt.  Configured via the
-    ``RT_RESTART_BACKOFF_*`` flags; ``base_s=0`` disables delays.
-    """
-
-    base_s: float = 1.0
-    max_s: float = 60.0
-    multiplier: float = 2.0
-    jitter: float = 0.2
-    rng: Any = field(default_factory=random.Random, repr=False)
-    _consecutive: int = 0
-
-    @classmethod
-    def from_config(cls, config=None) -> "RestartBackoff":
-        if config is None:
-            from ..core.config import RuntimeConfig
-
-            config = RuntimeConfig.from_env()
-        return cls(base_s=config.restart_backoff_base_s,
-                   max_s=config.restart_backoff_max_s,
-                   multiplier=config.restart_backoff_multiplier,
-                   jitter=config.restart_backoff_jitter)
-
-    def next_delay(self) -> float:
-        """Delay before the NEXT attempt; advances the schedule."""
-        if self.base_s <= 0:
-            return 0.0
-        raw = min(self.max_s,
-                  self.base_s * self.multiplier ** self._consecutive)
-        self._consecutive += 1
-        j = max(0.0, min(self.jitter, 1.0))
-        return raw * (1.0 + j * (2.0 * self.rng.random() - 1.0))
-
-    def reset(self) -> None:
-        self._consecutive = 0
+# Shared with the serve resilience plane's circuit breakers; lives in a
+# jax-free util module now (importing through ray_tpu.train pulls
+# jax/optax, which serve proxies must never pay for).
+from ..util.backoff import RestartBackoff  # noqa: F401,E402
 
 
 class TrainControllerV2:

@@ -25,6 +25,11 @@ rendered with an explanation and the suggested next probe —
                         median over a sliding window
   autoscaler decisions  recent ticks with unsatisfiable demand
   flight dumps          postmortems of recently dead workers
+  crashlooping replicas the same serve replica slot replaced again
+                        and again inside the probe window
+  open circuits         serve replicas routers black-holed after
+                        consecutive system faults (critical when a
+                        deployment has EVERY breaker open)
 
 The check functions are pure (plain dicts in, findings out) so they
 unit-test without a cluster; ``cluster_diagnosis`` wires them to a live
@@ -422,6 +427,86 @@ def find_draining_nodes(nodes: List[Dict], now: float) -> List[Dict]:
     return out
 
 
+def find_crashlooping_replicas(serve_stats: Dict, now: float,
+                               window_s: float = 120.0,
+                               min_replacements: int = 3
+                               ) -> List[Dict]:
+    """Serve replicas stuck in a crash loop: the SAME deployment
+    replica index replaced ``min_replacements``+ times inside the
+    probe window means the controller keeps paying replacement churn
+    for a replica that keeps dying — the deployment's own init/code,
+    its node, or its resources are the problem, not one unlucky
+    actor (the health loop alone would mask this forever)."""
+    out = []
+    deployments = (serve_stats or {}).get("deployments") or {}
+    for name, stats in deployments.items():
+        by_index: Dict[int, List[Dict]] = {}
+        for rec in stats.get("replacements", []):
+            if now - float(rec.get("ts", 0.0)) <= window_s:
+                by_index.setdefault(int(rec.get("index", 0)),
+                                    []).append(rec)
+        for index, recs in sorted(by_index.items()):
+            if len(recs) < min_replacements:
+                continue
+            reasons = sorted({r.get("reason", "?") for r in recs})
+            out.append(_finding(
+                "crashlooping_replica", "warning",
+                f"deployment {name!r} replica #{index} replaced "
+                f"{len(recs)}x in the last {window_s:.0f}s "
+                f"({', '.join(reasons)})",
+                detail="the controller keeps replacing this replica "
+                       "slot and it keeps dying — suspect the "
+                       "deployment's __init__/handler crashing, an "
+                       "OOM-killing node, or chaos; requests are "
+                       "riding failover retries meanwhile.",
+                probe="rt telemetry (serve section); rt logs; "
+                      "serve.status()",
+                data={"deployment": name, "index": index,
+                      "replacements": len(recs),
+                      "window_s": window_s, "reasons": reasons}))
+    return out
+
+
+def find_open_circuits(serve_stats: Dict, now: float,
+                       stale_s: float = 600.0) -> List[Dict]:
+    """Replica circuit breakers currently reported OPEN: routers are
+    deliberately black-holing these replicas after consecutive system
+    faults, ahead of the controller's own health probe — sustained
+    open circuits mean capacity is down and failover is carrying the
+    traffic."""
+    out = []
+    deployments = (serve_stats or {}).get("deployments") or {}
+    for name, stats in deployments.items():
+        open_keys = []
+        for key, rec in (stats.get("breakers") or {}).items():
+            if rec.get("state") != "open":
+                continue
+            if now - float(rec.get("ts", now)) > stale_s:
+                continue  # ancient report; the replica is long gone
+            open_keys.append(key)
+        if not open_keys:
+            continue
+        replicas = int(stats.get("replicas", 0))
+        all_open = replicas > 0 and len(open_keys) >= replicas
+        out.append(_finding(
+            "open_circuit",
+            "critical" if all_open else "warning",
+            f"deployment {name!r}: {len(open_keys)} replica "
+            f"breaker(s) OPEN"
+            + (f" of {replicas}" if replicas else "")
+            + (" — EVERY replica is black-holed" if all_open else ""),
+            detail="routers tripped these replicas after consecutive "
+                   "system faults and stopped sending them traffic; "
+                   "half-open probes will re-admit them when they "
+                   "answer again.  All-open means requests are "
+                   "failing fast with 503/UNAVAILABLE.",
+            probe="rt telemetry (serve breakers); serve.status(); "
+                  "rt doctor (crashlooping_replica)",
+            data={"deployment": name, "open": sorted(open_keys),
+                  "replicas": replicas}))
+    return out
+
+
 def find_infeasible_pgs(pgs: List[Dict], nodes: List[Dict]
                         ) -> List[Dict]:
     """Pending placement groups with a bundle no alive node's TOTAL
@@ -570,7 +655,8 @@ def find_flight_dumps(dumps: List[Dict], now: float,
 # ----------------------------------------------------- orchestration
 def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
              load: Dict, pgs: List[Dict], nodes: List[Dict],
-             ledgers: List[Dict], now: Optional[float] = None,
+             ledgers: List[Dict], serve: Optional[Dict] = None,
+             now: Optional[float] = None,
              collective_watchdog_s: float = 30.0,
              stuck_task_min_s: float = 60.0,
              stuck_task_p99_factor: float = 3.0,
@@ -586,6 +672,8 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
         feed.get("collective_inflight") or [], now,
         collective_watchdog_s)
     findings += find_draining_nodes(nodes, now)
+    findings += find_crashlooping_replicas(serve or {}, now)
+    findings += find_open_circuits(serve or {}, now)
     findings += find_lease_problems(ledgers, now)
     findings += find_pool_exhaustion(ledgers)
     findings += find_infeasible_pgs(pgs, nodes)
@@ -611,6 +699,8 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
                           for l in ledgers or []),
             "collectives_inflight": len(
                 feed.get("collective_inflight") or []),
+            "serve_deployments": len(
+                (serve or {}).get("deployments") or {}),
         },
     }
 
@@ -637,9 +727,13 @@ def cluster_diagnosis(*, address: Optional[str] = None
         pgs = []
     nodes = state_api.list_nodes(address=address)
     ledgers = state_api.list_leases(address=address)
+    try:
+        serve = state_api.serve_resilience(address=address)
+    except Exception:
+        serve = {}
     return diagnose(
         feed=feed, tasks=tasks, spans=spans, load=load, pgs=pgs,
-        nodes=nodes, ledgers=ledgers,
+        nodes=nodes, ledgers=ledgers, serve=serve,
         # Diagnose against the CONTROLLER's clock: collective entry
         # times are rebased onto it at report time, and the CLI/
         # dashboard host running this function may be skewed.

@@ -233,6 +233,29 @@ def load_metrics(*, address: Optional[str] = None) -> Dict[str, Any]:
     return _call("get_load_metrics", {}, address)
 
 
+def serve_resilience(*, address: Optional[str] = None
+                     ) -> Dict[str, Any]:
+    """The serve resilience plane's published stats (replica
+    replacement log, reported breaker states, admission-queue depth
+    per deployment), mirrored by the serve controller into the
+    cluster KV so `rt doctor` / `rt telemetry` read it over the plain
+    controller RPC.  Empty dict when serve is not running."""
+    import json as _json
+
+    try:
+        raw = _call("kv_get", {"key": "serve/resilience"}, address)
+    except Exception:
+        return {}
+    if not raw:
+        return {}
+    try:
+        if isinstance(raw, (bytes, bytearray)):
+            raw = raw.decode()
+        return _json.loads(raw)
+    except Exception:
+        return {}
+
+
 def list_leases(*, node_id: Optional[str] = None,
                 address: Optional[str] = None) -> List[Dict]:
     """Fan out over alive node agents and return each node's lease

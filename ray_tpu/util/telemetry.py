@@ -156,6 +156,30 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
             for s in snap.get("series", []):
                 serve["inflight"] = serve.get("inflight", 0.0) + float(
                     s.get("value", 0.0))
+        elif name in ("rt_serve_retries_total", "rt_serve_shed_total",
+                      "rt_serve_deadline_exceeded_total",
+                      "rt_serve_queue_depth"):
+            key = name.replace("rt_serve_", "").replace("_total", "")
+            for s in snap.get("series", []):
+                serve[key] = serve.get(key, 0.0) + float(
+                    s.get("value", 0.0))
+        elif name == "rt_serve_breaker_open":
+            for s in snap.get("series", []):
+                tags = s.get("tags") or {}
+                bkey = (f"{tags.get('deployment', '?')}/"
+                        f"{tags.get('replica', '?')}")
+                cur = serve.setdefault("breakers_open", {})
+                cur[bkey] = max(cur.get(bkey, 0.0),
+                                float(s.get("value", 0.0)))
+
+    # --- serve resilience stats published by the serve controller
+    # (replacement log, merged breaker reports, admission depth).
+    try:
+        resil = state_api.serve_resilience(address=address)
+        if resil.get("deployments"):
+            serve["resilience"] = resil["deployments"]
+    except Exception:
+        pass
 
     # --- per-step time series from the controller's retained history.
     series: Dict[str, List] = {}
@@ -268,6 +292,41 @@ def render_text(summary: Dict[str, Any]) -> str:
                          f"{h['mean'] * 1e3:.1f}ms  p99≤"
                          f"{h['p99'] * 1e3:.1f}ms")
         lines.append(f"  in-flight now: {serve.get('inflight', 0):.0f}")
+    if serve.get("retries") or serve.get("shed") or \
+            serve.get("deadline_exceeded") or serve.get("resilience"):
+        lines.append("\nServe resilience:")
+        lines.append(f"  failover retries    "
+                     f"{serve.get('retries', 0):.0f}")
+        lines.append(f"  shed (429)          "
+                     f"{serve.get('shed', 0):.0f}")
+        lines.append(f"  deadline exceeded   "
+                     f"{serve.get('deadline_exceeded', 0):.0f}")
+        if serve.get("queue_depth"):
+            lines.append(f"  queued now          "
+                         f"{serve['queue_depth']:.0f}")
+        open_now = sorted(k for k, v in
+                          (serve.get("breakers_open") or {}).items()
+                          if v >= 1.0)
+        if open_now:
+            lines.append(f"  open breakers       "
+                         f"{', '.join(open_now)}")
+        for dep, stats in sorted(
+                (serve.get("resilience") or {}).items()):
+            reps = stats.get("replacements", [])
+            brs = stats.get("breakers", {})
+            open_b = sorted(k[:12] for k, v in brs.items()
+                            if v.get("state") == "open")
+            lines.append(
+                f"  {dep:<20} replicas "
+                f"{stats.get('replicas', 0)}/"
+                f"{stats.get('target', 0)}"
+                + (f"  bleeding {stats['draining']}"
+                   if stats.get("draining") else "")
+                + f"  replaced {len(reps)}"
+                + (f"  queue {stats.get('queue_depth', 0)}"
+                   if stats.get("queue_depth") else "")
+                + (f"  open [{', '.join(open_b)}]" if open_b
+                   else ""))
 
     pool = summary.get("worker_pool") or {}
     if pool.get("target") or pool.get("adoptions") \

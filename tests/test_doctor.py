@@ -177,6 +177,77 @@ def test_autoscaler_unsatisfied_demand_surfaced():
     assert doctor.find_autoscaler_gaps(decisions, NOW + 10_000) == []
 
 
+# ---------------------------------------------- serve resilience (8)
+def test_crashlooping_replica_same_index_in_window():
+    serve = {"deployments": {"llm": {"replicas": 2, "target": 2,
+             "replacements": [
+                 {"index": 0, "ts": NOW - 100, "reason": "health_probe"},
+                 {"index": 0, "ts": NOW - 60, "reason": "health_probe"},
+                 {"index": 0, "ts": NOW - 5, "reason": "drain_bleed"},
+                 # a different index twice: NOT a loop
+                 {"index": 1, "ts": NOW - 50, "reason": "health_probe"},
+                 {"index": 1, "ts": NOW - 10, "reason": "health_probe"},
+                 # old replacements age out of the window
+                 {"index": 2, "ts": NOW - 500, "reason": "health_probe"},
+                 {"index": 2, "ts": NOW - 400, "reason": "health_probe"},
+                 {"index": 2, "ts": NOW - 300, "reason": "health_probe"},
+             ]}}}
+    found = doctor.find_crashlooping_replicas(serve, NOW,
+                                              window_s=120.0,
+                                              min_replacements=3)
+    assert len(found) == 1
+    f = found[0]
+    assert f["check"] == "crashlooping_replica"
+    assert f["data"]["deployment"] == "llm"
+    assert f["data"]["index"] == 0
+    assert f["data"]["replacements"] == 3
+    assert "drain_bleed" in f["summary"]
+
+
+def test_crashlooping_none_on_scattered_replacements():
+    serve = {"deployments": {"d": {"replacements": [
+        {"index": i, "ts": NOW - 5, "reason": "health_probe"}
+        for i in range(6)]}}}
+    assert doctor.find_crashlooping_replicas(serve, NOW) == []
+    assert doctor.find_crashlooping_replicas({}, NOW) == []
+
+
+def test_open_circuit_warning_and_all_open_critical():
+    serve = {"deployments": {
+        "a": {"replicas": 3, "target": 3, "breakers": {
+            "rep1": {"state": "open", "ts": NOW - 2},
+            "rep2": {"state": "closed", "ts": NOW - 2}}},
+        "b": {"replicas": 2, "target": 2, "breakers": {
+            "r1": {"state": "open", "ts": NOW - 1},
+            "r2": {"state": "open", "ts": NOW - 1}}},
+        "c": {"replicas": 1, "target": 1, "breakers": {
+            "stale": {"state": "open", "ts": NOW - 10_000}}},
+    }}
+    found = doctor.find_open_circuits(serve, NOW)
+    by_dep = {f["data"]["deployment"]: f for f in found}
+    assert set(by_dep) == {"a", "b"}   # c's report is stale
+    assert by_dep["a"]["severity"] == "warning"
+    assert by_dep["a"]["data"]["open"] == ["rep1"]
+    assert by_dep["b"]["severity"] == "critical"
+    assert "EVERY replica" in by_dep["b"]["summary"]
+
+
+def test_diagnose_carries_serve_findings():
+    serve = {"deployments": {"d": {"replicas": 1, "target": 1,
+             "breakers": {"r": {"state": "open", "ts": NOW - 1}},
+             "replacements": []}}}
+    diag = doctor.diagnose(feed={}, tasks=[], spans=[], load={},
+                           pgs=[], nodes=[], ledgers=[], serve=serve,
+                           now=NOW)
+    assert any(f["check"] == "open_circuit"
+               for f in diag["findings"])
+    assert diag["checked"]["serve_deployments"] == 1
+    # And serve-less clusters stay healthy.
+    diag2 = doctor.diagnose(feed={}, tasks=[], spans=[], load={},
+                            pgs=[], nodes=[], ledgers=[], now=NOW)
+    assert diag2["healthy"] is True
+
+
 # ------------------------------------------------- aggregation/render
 def test_diagnose_healthy_and_render():
     diag = doctor.diagnose(feed={}, tasks=[], spans=[], load={},

@@ -334,6 +334,162 @@ def test_async_handler_awaiting_actor_call():
     ray_tpu.kill(doubler)
 
 
+def test_failover_retries_replica_death_transparently():
+    """ISSUE 8 tentpole: a unary request that lands on a dying replica
+    is re-routed to a healthy one — the client never sees the
+    ActorDiedError the pre-resilience router surfaced."""
+    @serve.deployment(num_replicas=2, name="resil")
+    def resil(x):
+        return {"ok": x}
+
+    handle = serve.run(resil.bind(), route_prefix="/resil")
+    assert handle.call(0) == {"ok": 0}
+    ctl = ray_tpu.get_actor(serve.CONTROLLER_NAME)
+    reps = ray_tpu.get(ctl.get_replicas.remote("resil"))
+    ray_tpu.kill(reps[0])
+    # Every call after the kill must succeed via failover, well before
+    # the control loop replaces the dead replica.
+    for i in range(10):
+        assert handle.call(i, timeout_s=30) == {"ok": i}
+
+
+def test_user_exception_never_retried():
+    """User exceptions surface exactly once — only SYSTEM faults are
+    retried (retrying a deterministic handler bug would double side
+    effects)."""
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def get(self):
+            return self.n
+
+    counter = ray_tpu.remote(Counter).options(
+        name="resil_counter", num_cpus=0).remote()
+    ray_tpu.get(counter.get.remote())
+
+    @serve.deployment(num_replicas=1, name="usererr")
+    def usererr(x):
+        import ray_tpu as rt
+
+        rt.get(rt.get_actor("resil_counter").incr.remote())
+        raise ValueError("handler bug")
+
+    handle = serve.run(usererr.bind(), route_prefix="/usererr")
+    with pytest.raises(ValueError):
+        handle.call({})
+    assert ray_tpu.get(counter.get.remote()) == 1  # ran exactly once
+    ray_tpu.kill(counter)
+
+
+def test_request_deadline_maps_to_timeout_and_http_504():
+    from ray_tpu.serve.resilience import RequestTimeoutError
+
+    @serve.deployment(num_replicas=1, name="sleepy")
+    def sleepy(x):
+        time.sleep(5.0)
+        return x
+
+    handle = serve.run(sleepy.bind(), route_prefix="/sleepy")
+    t0 = time.time()
+    with pytest.raises(RequestTimeoutError):
+        handle.call({}, timeout_s=0.5)
+    assert time.time() - t0 < 4.0
+    # Per-request override over HTTP: X-RT-Timeout-S -> 504.
+    port = serve.start_http_proxy()
+    deadline = time.time() + 30
+    while True:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/sleepy",
+            data=json.dumps({}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-RT-Timeout-S": "0.5"})
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("expected 504")
+        except urllib.error.HTTPError as e:
+            if e.code == 404 and time.time() < deadline:
+                time.sleep(0.5)   # route push still propagating
+                continue
+            assert e.code == 504, e.code
+            break
+
+
+def test_admission_shed_oldest_raises_429_error():
+    """Overload beyond serve_max_queued sheds with the typed error
+    (the ingress maps it to HTTP 429 / gRPC RESOURCE_EXHAUSTED)."""
+    import threading as _threading
+
+    from ray_tpu.serve.controller import DeploymentHandle
+    from ray_tpu.serve.resilience import RequestShedError
+
+    @serve.deployment(num_replicas=1, name="narrow",
+                      max_ongoing_requests=1)
+    def narrow(x):
+        time.sleep(0.8)
+        return x
+
+    serve.run(narrow.bind(), route_prefix="/narrow")
+    import os as _os
+
+    _os.environ["RT_SERVE_MAX_QUEUED"] = "1"
+    try:
+        handle = DeploymentHandle("narrow")  # fresh: snapshots config
+    finally:
+        del _os.environ["RT_SERVE_MAX_QUEUED"]
+    outcomes = []
+
+    def one(i):
+        try:
+            handle.call(i, timeout_s=20)
+            outcomes.append("ok")
+        except RequestShedError:
+            outcomes.append("shed")
+        except Exception as e:  # noqa: BLE001
+            outcomes.append(repr(e))
+
+    threads = [_threading.Thread(target=one, args=(i,))
+               for i in range(6)]
+    for th in threads:
+        th.start()
+        time.sleep(0.05)
+    for th in threads:
+        th.join(60)
+    # Capacity 1 + queue 1: most of the burst is shed, the rest serve,
+    # and nothing times out or errors any other way.
+    assert outcomes.count("shed") >= 2, outcomes
+    assert outcomes.count("ok") >= 2, outcomes
+    assert set(outcomes) == {"ok", "shed"}, outcomes
+
+
+def test_stream_interruption_is_typed_never_silent():
+    """Mid-stream replica death surfaces the typed
+    StreamInterruptedError (after frames flowed), never a silent end."""
+    from ray_tpu.serve.resilience import StreamInterruptedError
+
+    @serve.deployment(num_replicas=1, name="hangstream")
+    def hangstream(x):
+        yield {"i": 0}
+        yield {"i": 1}
+        time.sleep(60)
+        yield {"i": 2}
+
+    handle = serve.run(hangstream.bind(), route_prefix="/hang")
+    it = handle.stream({})
+    assert next(it) == {"i": 0}
+    assert next(it) == {"i": 1}
+    ctl = ray_tpu.get_actor(serve.CONTROLLER_NAME)
+    reps = ray_tpu.get(ctl.get_replicas.remote("hangstream"))
+    ray_tpu.kill(reps[0])
+    with pytest.raises(StreamInterruptedError) as ei:
+        next(it)
+    assert ei.value.items_delivered == 2
+
+
 def test_grpc_ingress_roundtrip_and_stream():
     """A real gRPC client round-trips unary and streaming calls against
     the generic ingress (ref: proxy.py:540 gRPCProxy)."""
