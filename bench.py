@@ -406,6 +406,130 @@ def cold_start() -> None:
         ray_tpu.shutdown()
 
 
+def serve_llm() -> None:
+    """--serve-llm: load-test the LLM inference plane at saturating
+    concurrency — a tiny GPT-2 ``LLMDeployment`` (continuous-batching
+    engine + paged KV cache) behind serve, token streams pulled by
+    concurrent clients through ``handle.stream``.  Reports p50/p99
+    time-to-first-token and aggregate generated tokens/s, plus honest
+    decode MFU via ``decode_flops_per_token`` (the 6ND training count
+    would overstate it 3x); 0 off-TPU.  ``--record`` appends
+    serve_llm_tokens_per_sec (floored in PERF.jsonl) and the TTFT
+    percentiles."""
+    import dataclasses
+    import sys
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm import EngineConfig, llm_deployment
+    from ray_tpu.models.gpt2 import GPT2Config
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    quick = "--quick" in sys.argv
+    if on_tpu:
+        cfg = GPT2Config(n_layer=12, n_head=12, d_model=768, d_ff=3072,
+                         vocab_size=50257, max_seq=1024, remat=False)
+    else:
+        cfg = GPT2Config(vocab_size=512, n_layer=2, n_head=4,
+                         d_model=128, d_ff=512, max_seq=256,
+                         remat=False, dtype=jnp.float32)
+    cfg = dataclasses.replace(cfg, attn_impl="dense")
+    engine_cfg = EngineConfig(page_size=16, num_pages=256, max_batch=8,
+                              prefill_token_budget=512)
+    concurrency = 8                      # = max_batch: saturates the
+    per_client = 1 if quick else 4       # continuous batch
+    prompt_len, max_tokens = 16, 32
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(concurrency * per_client)]
+
+    ray_tpu.init(mode="cluster", num_cpus=4)
+    try:
+        handle = serve.run(
+            llm_deployment(name="llm", model="gpt2", model_cfg=cfg,
+                           engine_cfg=engine_cfg),
+            route_prefix="/llm")
+        # Warm the full path (replica __init__ already compiled the
+        # engine; this warms the handle/stream plumbing).
+        _ = [f for f in handle.stream(
+            {"prompt": prompts[0], "max_tokens": 4})]
+
+        ttfts, counts, errors = [], [], []
+        lock = threading.Lock()
+
+        def client(idx: int) -> None:
+            for r in range(per_client):
+                payload = {"prompt": prompts[idx * per_client + r],
+                           "max_tokens": max_tokens}
+                t0 = time.perf_counter()
+                first, n = None, 0
+                try:
+                    for fr in handle.stream(payload):
+                        if "error" in fr:
+                            raise RuntimeError(fr["error"])
+                        if "token" in fr:
+                            if first is None:
+                                first = time.perf_counter() - t0
+                            n += 1
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(repr(e))
+                    continue
+                with lock:
+                    ttfts.append(first)
+                    counts.append(n)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(concurrency)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(
+                f"{len(errors)} request(s) failed: {errors[:3]}")
+        stats = ray_tpu.get(handle.method("stats").remote(), timeout=30)
+    finally:
+        ray_tpu.shutdown()
+
+    tok_s = sum(counts) / wall
+    ttft_ms = np.asarray(sorted(ttfts)) * 1e3
+    p50 = float(np.percentile(ttft_ms, 50))
+    p99 = float(np.percentile(ttft_ms, 99))
+    import os
+
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = _PEAK_FLOPS.get(gen, _PEAK_FLOPS["v5e"])
+    mfu = (tok_s * cfg.decode_flops_per_token(prompt_len + max_tokens // 2)
+           / peak) if on_tpu else 0.0
+    out = {
+        "metric": "serve_llm_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu, 4),   # decode MFU (0 off-TPU)
+        "extra": {
+            "ttft_p50_ms": round(p50, 1),
+            "ttft_p99_ms": round(p99, 1),
+            "requests": len(counts),
+            "concurrency": concurrency,
+            "kv_pages_used_after": stats["kv_pages_used"],
+            "engine_steps": stats["steps"],
+            "evictions": stats["evictions"],
+        },
+    }
+    print(json.dumps(out))
+    _maybe_record(out, extra_rows=[
+        {"benchmark": "serve_llm_ttft_p50_ms", "value": round(p50, 1),
+         "unit": "ms", "higher_is_better": False},
+        {"benchmark": "serve_llm_ttft_p99_ms", "value": round(p99, 1),
+         "unit": "ms", "higher_is_better": False}])
+
+
 def _maybe_record(out: dict, extra_rows: list = None,
                   higher_is_better: bool = True) -> None:
     """--record: append to the PERF.jsonl round-over-round regression
@@ -433,5 +557,7 @@ if __name__ == "__main__":
         data_pipeline()
     elif "--cold-start" in sys.argv:
         cold_start()
+    elif "--serve-llm" in sys.argv:
+        serve_llm()
     else:
         main()

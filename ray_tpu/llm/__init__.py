@@ -1,0 +1,23 @@
+"""ray_tpu.llm — LLM inference plane.
+
+Continuous-batching generation engine (Orca-style iteration-level
+scheduling) over a vLLM-style paged KV cache, served through
+``ray_tpu.serve`` with token streaming, request autoscaling, and the
+PR-8 resilience semantics.  See README "LLM serving" and
+``bench.py --serve-llm``.
+
+The package imports jax lazily through its submodules' call paths
+where possible — ``sampling`` is numpy-only so pure sampling users
+never pay a jax import.
+"""
+
+from __future__ import annotations
+
+from .engine import EngineConfig, GenerationEngine  # noqa: F401
+from .sampling import SamplingParams, sample  # noqa: F401
+from .serving import LLMDeployment, llm_deployment  # noqa: F401
+
+__all__ = [
+    "EngineConfig", "GenerationEngine", "LLMDeployment",
+    "SamplingParams", "llm_deployment", "sample",
+]

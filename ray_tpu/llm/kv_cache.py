@@ -1,0 +1,172 @@
+"""Paged KV cache — fixed-size pages from one preallocated device pool.
+
+vLLM-style memory management adapted to JAX/TPU: the K/V history of
+every running sequence lives in ONE device buffer per model
+([n_layer, num_pages, page_size, n_kv_head, head_dim]), carved into
+fixed-size pages.  A sequence maps logical token positions to physical
+pages through its page table (position p lives in page
+``table[p // page_size]`` at slot ``p % page_size``), so sequences
+grow without reallocation or copying, free pages are recycled at step
+granularity, and fragmentation is bounded by one partial page per
+sequence.  Because the pool shape is static, the jitted decode step
+compiles once — admission/retirement only edits page tables and host
+accounting.
+
+Two pure jnp helpers implement the data path (used by the models'
+decode-mode forwards): ``paged_store`` scatters fresh K/V into pages,
+``paged_attend`` gathers a batch's pages and runs masked attention.
+``PagePool`` is the host-side allocator; it exports
+``rt_llm_kv_pages_{used,total}`` gauges on every alloc/free so KV
+occupancy is visible in ``rt telemetry`` and the doctor can see leaks.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def init_cache(n_layer: int, num_pages: int, page_size: int,
+               n_kv_head: int, head_dim: int, dtype: Any) -> Dict[str, Any]:
+    """Preallocate the pooled K/V buffers (zeros; pages are recycled
+    without clearing — the position mask in paged_attend makes stale
+    contents unreachable)."""
+    shape = (n_layer, num_pages, page_size, n_kv_head, head_dim)
+    return {"k_pages": jnp.zeros(shape, dtype),
+            "v_pages": jnp.zeros(shape, dtype)}
+
+
+def paged_store(k_pages, v_pages, k_new, v_new, page_table, positions):
+    """Scatter new K/V ([B, T, h_kv, d]) into the page pool.
+
+    ``positions`` is [B, T] absolute token positions; negative entries
+    are padding and are dropped (scatter mode="drop" via an
+    out-of-range page index), so one call serves prefill (T = padded
+    prompt length) and batched decode (T = 1, padded rows) alike.
+    """
+    num_pages, page_size = k_pages.shape[0], k_pages.shape[1]
+    pos = jnp.maximum(positions, 0)
+    page_ix = jnp.take_along_axis(page_table, pos // page_size, axis=1)
+    # Out-of-range index => dropped write for padded slots.
+    page_ix = jnp.where(positions >= 0, page_ix, num_pages)
+    slot = pos % page_size
+    k_pages = k_pages.at[page_ix, slot].set(
+        k_new.astype(k_pages.dtype), mode="drop")
+    v_pages = v_pages.at[page_ix, slot].set(
+        v_new.astype(v_pages.dtype), mode="drop")
+    return k_pages, v_pages
+
+
+def paged_attend(q, k_pages, v_pages, page_table, positions):
+    """Causal attention of q ([B, T, h, d]) against the paged cache.
+
+    Gathers each sequence's pages ([B, P, page, h_kv, d] ->
+    [B, P*page, h_kv, d]) and masks by ABSOLUTE position: cache slot j
+    is visible to a query at position p iff j <= p, which both
+    enforces causality and hides unwritten/stale slots (every position
+    <= p has been written by construction).  GQA caches store h_kv
+    heads and repeat to h at attend time, exactly like the full
+    forward."""
+    b, t, h, d = q.shape
+    ks = k_pages[page_table]          # [B, P, page, h_kv, d]
+    vs = v_pages[page_table]
+    p, page = ks.shape[1], ks.shape[2]
+    ks = ks.reshape(b, p * page, ks.shape[3], d)
+    vs = vs.reshape(b, p * page, vs.shape[3], d)
+    h_kv = ks.shape[2]
+    if h_kv != h:                      # GQA: repeat KV groups
+        rep = h // h_kv
+        ks = jnp.repeat(ks, rep, axis=2)
+        vs = jnp.repeat(vs, rep, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ks,
+                        preferred_element_type=jnp.float32)
+    scores = scores * (d ** -0.5)
+    kv_pos = jnp.arange(p * page, dtype=jnp.int32)
+    mask = kv_pos[None, None, None, :] <= positions[:, None, :, None]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vs)
+
+
+def pages_for(n_tokens: int, page_size: int) -> int:
+    return max(1, -(-n_tokens // page_size))
+
+
+class PagePool:
+    """Host-side allocator for the device page buffer.
+
+    All-or-nothing allocation (a sequence either gets every page it
+    asked for or stays queued — partial grants would deadlock two
+    growing sequences against each other), LIFO free list for locality,
+    occupancy exported as ``rt_llm_kv_pages_used`` /
+    ``rt_llm_kv_pages_total`` gauges on every transition.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages <= 0 or page_size <= 0:
+            raise ValueError("num_pages and page_size must be > 0")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._free: List[int] = list(range(self.num_pages - 1, -1, -1))
+        self._lock = threading.Lock()
+        # Gauge handles cached once — alloc/free is the decode hot
+        # path; re-constructing a Metric there would pay the global
+        # registry lock per transition.
+        self._gauges = None
+        try:
+            from ..util.metrics import Gauge
+
+            self._gauges = (
+                Gauge("rt_llm_kv_pages_used",
+                      "KV-cache pages currently allocated to "
+                      "sequences."),
+                Gauge("rt_llm_kv_pages_total",
+                      "Total KV-cache pages in the device pool."))
+        except Exception:
+            pass
+        self._publish(self.num_pages)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n pages or None (never a partial grant)."""
+        if n <= 0:
+            return []
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            pages = [self._free.pop() for _ in range(n)]
+            free_now = len(self._free)
+        self._publish(free_now)
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        if not pages:
+            return
+        with self._lock:
+            self._free.extend(pages)
+            free_now = len(self._free)
+            if free_now > self.num_pages:
+                raise AssertionError(
+                    f"page pool over-freed: {free_now} free of "
+                    f"{self.num_pages}")
+        self._publish(free_now)
+
+    @property
+    def available(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used(self) -> int:
+        return self.num_pages - self.available
+
+    def _publish(self, free_now: int) -> None:
+        if self._gauges is None:
+            return
+        try:
+            self._gauges[0].set(float(self.num_pages - free_now))
+            self._gauges[1].set(float(self.num_pages))
+        except Exception:
+            pass

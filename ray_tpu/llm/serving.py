@@ -1,0 +1,145 @@
+"""LLM serving — the generation engine deployed through ``serve``.
+
+``LLMDeployment`` hosts ONE GenerationEngine per replica; its
+``__call__`` is a generator, so serve routes it through the existing
+streaming plane end to end: tokens ride the core ObjectRefGenerator
+path, the HTTP/gRPC proxies deliver them as chunked ndjson / gRPC
+streams, and PR-8's resilience semantics apply unchanged (pre-first-
+token failures retry on another replica, mid-stream faults surface as
+the typed StreamInterruptedError / ``__rt_stream_error__`` terminal
+frame — never silent truncation).
+
+Scaling and lifecycle reuse the serve planes as-is: a live stream
+counts as an ongoing request, so the request autoscaler sees engine
+load + admission-queue depth directly; ``max_ongoing_requests``
+defaults to the engine's continuous-batch capacity so overload queues
+(and sheds) at the handle instead of overcommitting a replica; and
+replicas on DRAINING nodes bleed off through the serve controller's
+existing drain path.  A client that disconnects mid-stream triggers
+the generator's ``finally``, which cancels the sequence and frees its
+KV pages (the eviction path, pinned by tests).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .engine import EngineConfig, GenerationEngine
+from .sampling import SamplingParams
+
+
+class LLMDeployment:
+    """Serve deployment class: one engine per replica, streaming
+    token frames per request.
+
+    Request payload (JSON-able dict):
+      {"prompt": [token ids], "max_tokens": int?, "temperature": f?,
+       "top_k": int?, "top_p": f?, "seed": int?}
+    Response frames: {"token": id, "index": i} per token, then
+      {"done": true, "reason": "eos"|"length", "n_tokens": n}
+    (or {"error": "..."} for a rejected/failed request).
+    """
+
+    def __init__(self, model: str = "gpt2", model_cfg: Any = None,
+                 engine_cfg: Optional[EngineConfig] = None,
+                 seed: int = 0, warmup: bool = True):
+        import threading
+
+        # Engine construction (jax import, weight init, prefill/decode
+        # compiles) can take tens of seconds — far past the serve
+        # controller's health-probe deadline, which would kill and
+        # replace a replica still in __init__ forever.  So __init__
+        # returns immediately (the actor answers health probes) and a
+        # background thread builds + warms the engine; requests block
+        # on readiness.
+        self._ready = threading.Event()
+        self._init_error: Optional[str] = None
+        self._engine: Optional[GenerationEngine] = None
+
+        def _build() -> None:
+            try:
+                engine = GenerationEngine(
+                    model=model, model_cfg=model_cfg,
+                    engine_cfg=engine_cfg, seed=seed).start()
+                if warmup:
+                    # Pay compiles now, not on the first request's
+                    # TTFT.
+                    engine.warmup()
+                self._engine = engine
+            except Exception as e:  # noqa: BLE001 — surfaced per call
+                self._init_error = repr(e)
+            finally:
+                self._ready.set()
+
+        threading.Thread(target=_build, daemon=True,
+                         name="llm-engine-init").start()
+
+    def _engine_or_raise(self, timeout_s: float = 600.0
+                         ) -> GenerationEngine:
+        if not self._ready.wait(timeout_s):
+            raise RuntimeError("LLM engine initialization timed out")
+        if self._init_error is not None:
+            raise RuntimeError(
+                f"LLM engine failed to initialize: {self._init_error}")
+        return self._engine
+
+    def __call__(self, payload: Optional[Dict[str, Any]]):
+        engine = self._engine_or_raise()
+        payload = payload or {}
+        try:
+            prompt = [int(t) for t in payload["prompt"]]
+            params = SamplingParams(
+                temperature=float(payload.get("temperature", 0.0)),
+                top_k=int(payload.get("top_k", 0)),
+                top_p=float(payload.get("top_p", 1.0)))
+            seq = engine.submit(
+                prompt,
+                max_tokens=payload.get("max_tokens"),
+                params=params,
+                seed=payload.get("seed"))
+        except (KeyError, TypeError, ValueError) as e:
+            yield {"error": f"bad request: {e!r}"}
+            return
+        try:
+            for frame in engine.frames(seq):
+                yield frame
+        finally:
+            # Client gone (GeneratorExit) or stream complete: cancel is
+            # a no-op on finished sequences, and the eviction path for
+            # disconnects — pages freed, sequence out of the batch.
+            engine.cancel(seq.sid)
+
+    def stats(self) -> Dict[str, Any]:
+        return self._engine_or_raise().stats()
+
+
+def llm_deployment(name: str = "llm", model: str = "gpt2",
+                   model_cfg: Any = None,
+                   engine_cfg: Optional[EngineConfig] = None,
+                   num_replicas: int = 1,
+                   autoscaling: Any = None,
+                   max_ongoing_requests: Optional[int] = None,
+                   num_cpus: float = 1, seed: int = 0,
+                   warmup: bool = True,
+                   route_prefix: Optional[str] = None):
+    """Build the serve Application for an LLM deployment.
+
+    ``autoscaling`` takes a serve.AutoscalingConfig: replica count then
+    follows engine load — streams in flight plus handle queue depth —
+    through the existing request autoscaler.  ``max_ongoing_requests``
+    defaults to the engine's max_batch so admission control saturates
+    exactly when the continuous batch does.
+    """
+    from .. import serve
+
+    engine_cfg = engine_cfg or EngineConfig()
+    if max_ongoing_requests is None:
+        max_ongoing_requests = engine_cfg.max_batch
+    dep = serve.deployment(
+        LLMDeployment, name=name, num_replicas=num_replicas,
+        ray_actor_options={"num_cpus": num_cpus},
+        autoscaling_config=autoscaling,
+        route_prefix=route_prefix,
+        max_ongoing_requests=max_ongoing_requests)
+    return dep.bind(model=model, model_cfg=model_cfg,
+                    engine_cfg=engine_cfg, seed=seed, warmup=warmup)

@@ -73,6 +73,22 @@ class GPT2Config:
         attn = 6 * 2 * self.n_layer * self.d_model * self.max_seq
         return 6.0 * n_params + attn
 
+    def decode_flops_per_token(self,
+                               context_len: Optional[int] = None) -> float:
+        """FLOPs to DECODE one token with a KV cache at ``context_len``
+        (defaults to max_seq/2, the mean context of a full generation):
+        2 FLOPs per matmul weight — forward only, the training 6ND
+        count would overstate decode MFU 3x — plus reading the cached
+        K/V once per layer (QK^T + PV).  Embedding/positional lookups
+        are gathers, not matmuls, so only the tied unembedding
+        projection counts for wte."""
+        ctx = self.max_seq // 2 if context_len is None else context_len
+        matmul_params = (self.vocab_size * self.d_model
+                         + self.n_layer * (4 * self.d_model ** 2
+                                           + 2 * self.d_model * self.d_ff))
+        attn = 4 * self.n_layer * self.d_model * ctx
+        return 2.0 * matmul_params + attn
+
 
 def _constrain(x, logical, cfg: GPT2Config):
     rules = cfg.rules or ShardingRules()
@@ -126,7 +142,7 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache=None):
         cfg = self.cfg
         h = cfg.n_head
         d_head = cfg.d_model // h
@@ -138,10 +154,26 @@ class Block(nn.Module):
         q = q.reshape(b, t, h, d_head)
         k = k.reshape(b, t, h, d_head)
         v = v.reshape(b, t, h, d_head)
-        q = _constrain(q, ("batch", "seq", "heads", None), cfg)
-        k = _constrain(k, ("batch", "seq", "heads", None), cfg)
-        v = _constrain(v, ("batch", "seq", "heads", None), cfg)
-        att = _attention(cfg, q, k, v).reshape(b, t, cfg.d_model)
+        if cache is not None:
+            # Decode mode: write this step's K/V into the paged pool,
+            # attend q against the gathered history (prefill and
+            # single-token decode take the same path).  Runs unsharded
+            # — the serving engine hosts one replica per chip.
+            from ..llm.kv_cache import paged_attend, paged_store
+
+            k_pages, v_pages = paged_store(
+                cache["k_pages"], cache["v_pages"], k, v,
+                cache["page_table"], cache["positions"])
+            att = paged_attend(q, k_pages, v_pages,
+                               cache["page_table"], cache["positions"])
+            new_cache = (k_pages, v_pages)
+        else:
+            q = _constrain(q, ("batch", "seq", "heads", None), cfg)
+            k = _constrain(k, ("batch", "seq", "heads", None), cfg)
+            v = _constrain(v, ("batch", "seq", "heads", None), cfg)
+            att = _attention(cfg, q, k, v)
+            new_cache = None
+        att = att.reshape(b, t, cfg.d_model)
         att = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="c_proj",
                        kernel_init=nn.initializers.normal(
                            0.02 / (2 * cfg.n_layer) ** 0.5))(att)
@@ -155,7 +187,8 @@ class Block(nn.Module):
                        top_k=cfg.moe_top_k,
                        capacity_factor=cfg.moe_capacity_factor,
                        dtype=cfg.dtype, name="moe_mlp")(y)
-            return x + y
+            out = x + y
+            return out if new_cache is None else (out, new_cache)
         y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="mlp_in",
                      kernel_init=nn.initializers.normal(0.02))(y)
         y = _constrain(y, ("batch", "seq", "mlp"), cfg)
@@ -163,36 +196,70 @@ class Block(nn.Module):
         y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlp_out",
                      kernel_init=nn.initializers.normal(
                          0.02 / (2 * cfg.n_layer) ** 0.5))(y)
-        return x + y
+        out = x + y
+        return out if new_cache is None else (out, new_cache)
 
 
 class GPT2(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, tokens, return_hidden: bool = False):
+    def __call__(self, tokens, return_hidden: bool = False,
+                 kv_cache=None, positions=None):
+        """Full forward (kv_cache=None) or incremental decode step.
+
+        Decode mode attends against the paged KV pool instead of
+        recomputing the sequence: ``kv_cache`` is {"k_pages",
+        "v_pages": [L, pages, page, h, d], "page_table": [B, P]} and
+        ``positions`` [B, T] gives each new token's absolute position
+        (negative = padding).  One prefill call (T = prompt length)
+        populates the cache; each decode call appends T=1 tokens.
+        Returns (logits, new_kv_cache) — token-identical to the full
+        forward (pinned by tests/test_llm.py)."""
         cfg = self.cfg
+        decode = kv_cache is not None
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.max_seq, cfg.d_model), jnp.float32)
         t = tokens.shape[1]
-        x = wte.astype(cfg.dtype)[tokens] + wpe.astype(cfg.dtype)[:t]
+        if decode:
+            pos = jnp.maximum(positions, 0)
+            x = wte.astype(cfg.dtype)[tokens] + wpe.astype(cfg.dtype)[pos]
+        else:
+            x = wte.astype(cfg.dtype)[tokens] + wpe.astype(cfg.dtype)[:t]
         x = _constrain(x, ("batch", "seq", "embed"), cfg)
         block = Block
-        if cfg.remat:
+        if cfg.remat and not decode:
+            # Decode steps are memory-light; remat would only slow them.
             block = nn.remat(Block, prevent_cse=False)
+        new_k, new_v = [], []
         for i in range(cfg.n_layer):
             use_moe = (cfg.moe_num_experts > 0
                        and i % cfg.moe_every == cfg.moe_every - 1)
-            x = block(cfg, use_moe=use_moe, name=f"h_{i}")(x)
+            blk = block(cfg, use_moe=use_moe, name=f"h_{i}")
+            if decode:
+                x, (k_i, v_i) = blk(
+                    x, cache={"k_pages": kv_cache["k_pages"][i],
+                              "v_pages": kv_cache["v_pages"][i],
+                              "page_table": kv_cache["page_table"],
+                              "positions": positions})
+                new_k.append(k_i)
+                new_v.append(v_i)
+            else:
+                x = blk(x)
             x = _constrain(x, ("batch", "seq", "embed"), cfg)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         if return_hidden:
             return x
         logits = jnp.einsum("btd,vd->btv", x, wte.astype(cfg.dtype),
                             preferred_element_type=jnp.float32)
-        return _constrain(logits, ("batch", "seq", "vocab"), cfg)
+        logits = _constrain(logits, ("batch", "seq", "vocab"), cfg)
+        if decode:
+            return logits, {"k_pages": jnp.stack(new_k),
+                            "v_pages": jnp.stack(new_v),
+                            "page_table": kv_cache["page_table"]}
+        return logits
 
 
 def gpt2_init(cfg: GPT2Config, rng) -> Any:

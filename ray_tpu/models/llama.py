@@ -15,6 +15,7 @@ from typing import Any, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..parallel.sharding import ShardingRules, with_logical_constraint
 
@@ -58,6 +59,25 @@ class LlamaConfig:
         attn = 6 * 2 * self.n_layer * self.d_model * self.max_seq
         return 6.0 * n_params + attn
 
+    def decode_flops_per_token(self,
+                               context_len: Optional[int] = None) -> float:
+        """FLOPs to DECODE one token with a KV cache at ``context_len``
+        (defaults to max_seq/2): forward-only 2-FLOPs-per-matmul-weight
+        plus one read of the cached K/V per layer (QK^T + PV over all
+        n_head query heads — GQA shrinks the cache, not the attention
+        arithmetic).  The training ``flops_per_token`` 6ND count would
+        overstate decode MFU 3x."""
+        head_dim = self.d_model // self.n_head
+        ctx = self.max_seq // 2 if context_len is None else context_len
+        matmul_params = (self.vocab_size * self.d_model   # lm_head only
+                         + self.n_layer * (
+                             self.d_model * self.d_model
+                             + 2 * self.d_model * self.n_kv_head * head_dim
+                             + self.d_model * self.d_model
+                             + 3 * self.d_model * self.d_ff))
+        attn = 4 * self.n_layer * self.d_model * ctx
+        return 2.0 * matmul_params + attn
+
 
 def _constrain(x, logical, cfg):
     if cfg.mesh is None:
@@ -66,14 +86,39 @@ def _constrain(x, logical, cfg):
                                    cfg.rules or ShardingRules())
 
 
-def _rope(x, theta: float):
-    """Rotary embedding over [B, T, H, D] (D even)."""
+@functools.lru_cache(maxsize=64)
+def _rope_tables(seq_len: int, head_dim: int, theta: float):
+    """Cached sin/cos tables keyed by (seq_len, head_dim): every block
+    of every forward shares one host constant per shape instead of
+    re-deriving the tables inside each traced layer (they are shape-
+    static, so recomputation bought nothing but trace time and
+    duplicated constants).  Deliberately NUMPY arrays — caching a
+    jnp array materialized under an outer jit would leak that trace's
+    tracer into later traces; numpy constants embed safely anywhere.
+    Returns ([T, D/2] cos, [T, D/2] sin) in fp32."""
+    half = head_dim // 2
+    freqs = theta ** (-np.arange(0, half, dtype=np.float32) / half)
+    angles = np.arange(seq_len, dtype=np.float32)[:, None] * freqs[None, :]
+    return np.cos(angles), np.sin(angles)
+
+
+def _rope(x, theta: float, positions=None):
+    """Rotary embedding over [B, T, H, D] (D even).  ``positions``
+    ([B, T] absolute, negative = padding) selects per-token angles for
+    the decode path; None means contiguous 0..T-1 (training/prefill
+    full forward) served from the cached tables."""
     b, t, h, d = x.shape
     half = d // 2
-    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    if positions is None:
+        cos, sin = _rope_tables(t, d, theta)
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    else:
+        pos = jnp.maximum(positions, 0).astype(jnp.float32)
+        freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+        angles = pos[..., None] * freqs            # [B, T, half]
+        cos = jnp.cos(angles)[:, :, None, :]
+        sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
                           axis=-1)
@@ -126,7 +171,7 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache=None):
         cfg = self.cfg
         h, hk = cfg.n_head, cfg.n_kv_head
         d_head = cfg.d_model // h
@@ -141,16 +186,32 @@ class LlamaBlock(nn.Module):
         v = nn.Dense(hk * d_head, use_bias=False, dtype=cfg.dtype,
                      kernel_init=init, name="wv")(y).reshape(b, t, hk,
                                                              d_head)
-        q = _rope(q, cfg.rope_theta)
-        k = _rope(k, cfg.rope_theta)
-        if hk != h:  # GQA: repeat KV groups to full heads
-            rep = h // hk
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        q = _constrain(q, ("batch", "seq", "heads", None), cfg)
-        k = _constrain(k, ("batch", "seq", "heads", None), cfg)
-        v = _constrain(v, ("batch", "seq", "heads", None), cfg)
-        att = _attention(cfg, q, k, v).reshape(b, t, cfg.d_model)
+        positions = cache["positions"] if cache is not None else None
+        q = _rope(q, cfg.rope_theta, positions)
+        k = _rope(k, cfg.rope_theta, positions)
+        if cache is not None:
+            # Decode mode: the cache stores the hk GROUPED heads
+            # (post-RoPE); repeat-to-h happens at attend time, so GQA
+            # shrinks the pooled cache by h/hk.
+            from ..llm.kv_cache import paged_attend, paged_store
+
+            k_pages, v_pages = paged_store(
+                cache["k_pages"], cache["v_pages"], k, v,
+                cache["page_table"], positions)
+            att = paged_attend(q, k_pages, v_pages,
+                               cache["page_table"], positions)
+            new_cache = (k_pages, v_pages)
+        else:
+            if hk != h:  # GQA: repeat KV groups to full heads
+                rep = h // hk
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            q = _constrain(q, ("batch", "seq", "heads", None), cfg)
+            k = _constrain(k, ("batch", "seq", "heads", None), cfg)
+            v = _constrain(v, ("batch", "seq", "heads", None), cfg)
+            att = _attention(cfg, q, k, v)
+            new_cache = None
+        att = att.reshape(b, t, cfg.d_model)
         att = nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
                        kernel_init=init, name="wo")(att)
         x = x + att
@@ -163,31 +224,52 @@ class LlamaBlock(nn.Module):
         z = _constrain(z, ("batch", "seq", "mlp"), cfg)
         down = nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
                         kernel_init=init, name="w_down")(z)
-        return x + down
+        out = x + down
+        return out if new_cache is None else (out, new_cache)
 
 
 class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, kv_cache=None, positions=None):
+        """Full forward (kv_cache=None) or incremental decode step
+        against the paged KV pool — same contract as GPT2.__call__:
+        decode mode returns (logits, new_kv_cache)."""
         cfg = self.cfg
+        decode = kv_cache is not None
         emb = self.param("embed", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
         x = emb.astype(cfg.dtype)[tokens]
         x = _constrain(x, ("batch", "seq", "embed"), cfg)
         block = LlamaBlock
-        if cfg.remat:
+        if cfg.remat and not decode:
             block = nn.remat(LlamaBlock, prevent_cse=False)
+        new_k, new_v = [], []
         for i in range(cfg.n_layer):
-            x = block(cfg, name=f"layer_{i}")(x)
+            blk = block(cfg, name=f"layer_{i}")
+            if decode:
+                x, (k_i, v_i) = blk(
+                    x, cache={"k_pages": kv_cache["k_pages"][i],
+                              "v_pages": kv_cache["v_pages"][i],
+                              "page_table": kv_cache["page_table"],
+                              "positions": positions})
+                new_k.append(k_i)
+                new_v.append(v_i)
+            else:
+                x = blk(x)
             x = _constrain(x, ("batch", "seq", "embed"), cfg)
         x = RMSNorm(cfg.rms_eps, cfg.dtype, name="norm_f")(x)
         head = self.param("lm_head", nn.initializers.normal(0.02),
                           (cfg.d_model, cfg.vocab_size), jnp.float32)
         logits = jnp.einsum("btd,dv->btv", x, head.astype(cfg.dtype),
                             preferred_element_type=jnp.float32)
-        return _constrain(logits, ("batch", "seq", "vocab"), cfg)
+        logits = _constrain(logits, ("batch", "seq", "vocab"), cfg)
+        if decode:
+            return logits, {"k_pages": jnp.stack(new_k),
+                            "v_pages": jnp.stack(new_v),
+                            "page_table": kv_cache["page_table"]}
+        return logits
 
 
 def llama_init(cfg: LlamaConfig, rng):

@@ -86,8 +86,25 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
                     "restore_total": 0.0}
     worker_pool = {"idle": 0.0, "target": 0.0, "adoptions": 0.0,
                    "cold_spawns": 0.0, "startup": {}}
+    llm = {"kv_pages_used": 0.0, "kv_pages_total": 0.0,
+           "batch_size": 0.0, "waiting": 0.0, "tokens": 0.0,
+           "prefill_tokens": 0.0, "evictions": 0.0, "engines": 0}
     for src, snap in _iter_metrics(sources):
         name = snap.get("name", "")
+        if name.startswith("rt_llm_"):
+            key = {"rt_llm_kv_pages_used": "kv_pages_used",
+                   "rt_llm_kv_pages_total": "kv_pages_total",
+                   "rt_llm_batch_size": "batch_size",
+                   "rt_llm_waiting": "waiting",
+                   "rt_llm_tokens_total": "tokens",
+                   "rt_llm_prefill_tokens_total": "prefill_tokens",
+                   "rt_llm_evictions_total": "evictions"}.get(name)
+            if key is not None:
+                if name == "rt_llm_kv_pages_total":
+                    llm["engines"] += 1
+                for s in snap.get("series", []):
+                    llm[key] += float(s.get("value", 0.0))
+            continue
         if name in ("rt_object_spilled_bytes", "rt_object_spill_total",
                     "rt_object_restore_total"):
             key = name.replace("rt_object_", "")
@@ -203,6 +220,7 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
         "serve": serve,
         "object_store": object_store,
         "worker_pool": worker_pool,
+        "llm": llm,
         "flight": raw.get("flight", []),
     }
 
@@ -327,6 +345,22 @@ def render_text(summary: Dict[str, Any]) -> str:
                    if stats.get("queue_depth") else "")
                 + (f"  open [{', '.join(open_b)}]" if open_b
                    else ""))
+
+    llm = summary.get("llm") or {}
+    if llm.get("kv_pages_total"):
+        lines.append("\nLLM engine (continuous batching):")
+        used, total = llm["kv_pages_used"], llm["kv_pages_total"]
+        lines.append(
+            f"  KV pool        {used:.0f} / {total:.0f} pages "
+            f"({100 * used / max(total, 1):.1f}% across "
+            f"{llm.get('engines', 0)} engine(s))")
+        lines.append(f"  batch now      {llm.get('batch_size', 0):.0f} "
+                     f"decoding, {llm.get('waiting', 0):.0f} waiting")
+        lines.append(f"  tokens out     {llm.get('tokens', 0):.0f}  "
+                     f"(prefilled {llm.get('prefill_tokens', 0):.0f})")
+        if llm.get("evictions"):
+            lines.append(f"  evictions      {llm['evictions']:.0f} "
+                         "(KV-pressure recompute preemptions)")
 
     pool = summary.get("worker_pool") or {}
     if pool.get("target") or pool.get("adoptions") \
