@@ -7,5 +7,7 @@ threaded through ray_tpu.parallel.sharding rules, so DP/FSDP/TP/CP
 layouts are a rules-table choice, not a model edit.
 """
 
-from .gpt2 import GPT2, GPT2Config, gpt2_loss_fn, gpt2_param_axes  # noqa
-from .llama import Llama, LlamaConfig, llama_loss_fn, llama_param_axes  # noqa
+from .gpt2 import (GPT2, GPT2Config, gpt2_loss_fn,  # noqa: F401
+                   gpt2_param_axes, gpt2_partition_rules)
+from .llama import (Llama, LlamaConfig, llama_loss_fn,  # noqa: F401
+                    llama_param_axes, llama_partition_rules)

@@ -381,6 +381,29 @@ def gpt2_loss_fn(cfg: GPT2Config, params, batch,
     return -jnp.mean(ll) + cfg.moe_aux_weight * aux
 
 
+def gpt2_partition_rules():
+    """Default fsdp+tensor partition rules for GPT-2 param trees, in
+    ``match_partition_rules`` form ((regex, PartitionSpec) pairs, first
+    match wins).  Mirrors ``gpt2_param_axes`` through the DEFAULT_RULES
+    table (vocab/heads/mlp → ``tensor``, embed_fsdp → ``fsdp``) but as
+    path regexes, so the elastic checkpoint plane can persist and
+    re-derive layouts without importing model code."""
+    from jax.sharding import PartitionSpec as PS
+
+    return (
+        ("wte$", PS("tensor", "fsdp")),
+        ("wpe$", PS()),
+        (r"c_attn/kernel$", PS("fsdp", "tensor")),
+        (r"c_proj/kernel$", PS("tensor", "fsdp")),
+        (r"mlp_in/kernel$", PS("fsdp", "tensor")),
+        (r"mlp_out/kernel$", PS("tensor", "fsdp")),
+        (r"moe_mlp/w_in$", PS("expert", "fsdp", "tensor")),
+        (r"moe_mlp/w_out$", PS("expert", "tensor", "fsdp")),
+        (r"moe_mlp/router$", PS("fsdp", None)),
+        (r"(bias|scale)$", PS()),
+    )
+
+
 def gpt2_param_axes(path: str, leaf) -> Tuple[Optional[str], ...]:
     """Logical axes per parameter path for shard_pytree
     (DP/FSDP/TP/EP)."""

@@ -289,6 +289,22 @@ def llama_loss_fn(cfg: LlamaConfig, params, batch):
     return -jnp.mean(ll)
 
 
+def llama_partition_rules():
+    """Default fsdp+tensor partition rules for Llama param trees
+    (``match_partition_rules`` form; see ``gpt2_partition_rules``)."""
+    from jax.sharding import PartitionSpec as PS
+
+    return (
+        ("embed$", PS("tensor", "fsdp")),
+        ("lm_head$", PS("fsdp", "tensor")),
+        (r"w[qkv]/kernel$", PS("fsdp", "tensor")),
+        (r"wo/kernel$", PS("tensor", "fsdp")),
+        (r"(w_gate|w_up)/kernel$", PS("fsdp", "tensor")),
+        (r"w_down/kernel$", PS("tensor", "fsdp")),
+        (r"(scale|bias)$", PS()),
+    )
+
+
 def llama_param_axes(path: str, leaf) -> Tuple[Optional[str], ...]:
     if "embed" in path and leaf.ndim == 2:
         return ("vocab", "embed_fsdp")

@@ -11,6 +11,9 @@ here.
 from .mesh import MeshSpec, create_mesh, local_mesh  # noqa: F401
 from .sharding import (ShardingRules, logical_sharding,  # noqa: F401
                        shard_pytree, with_logical_constraint)
+from .partition_rules import (match_partition_rules,  # noqa: F401
+                              named_tree_map, prune_spec, shard_tree,
+                              tree_shardings)
 from .ring_attention import ring_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
 from .pipeline import pipeline_apply  # noqa: F401

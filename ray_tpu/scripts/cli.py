@@ -442,7 +442,8 @@ def cmd_doctor(args) -> int:
     if not address:
         print("No running cluster found.", file=sys.stderr)
         return 1
-    diag = doctor_mod.cluster_diagnosis(address=address)
+    diag = doctor_mod.cluster_diagnosis(
+        address=address, run_dir=getattr(args, "run_dir", "") or None)
     if args.format == "json":
         print(json.dumps(diag, indent=2, default=repr))
     else:
@@ -450,6 +451,55 @@ def cmd_doctor(args) -> int:
     critical = any(f.get("severity") == "critical"
                    for f in diag.get("findings", []))
     return 1 if critical else 0
+
+
+def cmd_checkpoint_verify(args) -> int:
+    """Offline integrity check of one checkpoint directory: commit
+    status, manifest sanity, per-shard-file checksums, and slice
+    coverage of every leaf — the operator's answer to "can this run
+    actually resume from here?".  Exits non-zero on a torn or corrupt
+    directory (no cluster needed)."""
+    from ray_tpu.util.checkpoint_fs import verify_checkpoint
+
+    report = verify_checkpoint(args.dir)
+    if args.format == "json":
+        print(json.dumps(report, indent=2))
+        return 0 if report["ok"] else 1
+    status = "OK (committed)" if report["ok"] else (
+        "CORRUPT" if report["committed"] else "NOT COMMITTED (torn)")
+    print(f"{report['path']}: {status}")
+    if report.get("sharded"):
+        mesh = report.get("mesh") or {}
+        mesh_s = "x".join(f"{k}={v}" for k, v in mesh.items()) or "?"
+        print(f"  sharded: world={report.get('world_size')} "
+              f"mesh[{mesh_s}]  {report['leaves']} leaves in "
+              f"{report['files']} shard file(s), "
+              f"{report['bytes']} bytes")
+    for err in report["errors"]:
+        print(f"  error: {err}")
+    if not report["ok"]:
+        print("  resume will skip this directory and fall back to "
+              "the previous committed checkpoint.")
+    return 0 if report["ok"] else 1
+
+
+def cmd_checkpoint_list(args) -> int:
+    """List every checkpoint_* entry of a run directory with its
+    commit status — committed, torn, or in-flight staging."""
+    from ray_tpu.util.checkpoint_fs import scan_run_dir
+
+    entries = scan_run_dir(args.run_dir)
+    if args.format == "json":
+        print(json.dumps(entries, indent=2))
+        return 0
+    if not entries:
+        print(f"no checkpoint_* entries in {args.run_dir}")
+        return 0
+    for e in entries:
+        state = ("staging" if e["tmp"]
+                 else "committed" if e["committed"] else "TORN")
+        print(f"  {e['name']:<28} {state}")
+    return 0
 
 
 def cmd_drain(args) -> int:
@@ -905,7 +955,30 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address", default="")
     sp.add_argument("--format", choices=["text", "json"],
                     default="text")
+    sp.add_argument("--run-dir", default="",
+                    help="also scan this training run directory for "
+                         "torn/uncommitted checkpoint dirs")
     sp.set_defaults(fn=cmd_doctor)
+
+    sp = sub.add_parser("checkpoint",
+                        help="inspect/verify checkpoint directories "
+                             "(sharded manifest + checksums)")
+    csub = sp.add_subparsers(dest="ckpt_command", required=True)
+    c = csub.add_parser("verify",
+                        help="validate a checkpoint dir: commit "
+                             "status, manifest, per-file checksums, "
+                             "slice coverage")
+    c.add_argument("dir", help="checkpoint directory")
+    c.add_argument("--format", choices=["text", "json"],
+                   default="text")
+    c.set_defaults(fn=cmd_checkpoint_verify)
+    c = csub.add_parser("list",
+                        help="list checkpoint_* entries in a run dir "
+                             "with commit status")
+    c.add_argument("run_dir", help="training run directory")
+    c.add_argument("--format", choices=["text", "json"],
+                   default="text")
+    c.set_defaults(fn=cmd_checkpoint_list)
 
     sp = sub.add_parser("drain",
                         help="gracefully drain a node (stop leases, "

@@ -6,5 +6,5 @@ NodeKillerBase / WorkerKillerActor).
 """
 
 from .chaos import (NodeKiller, PreemptionKiller,  # noqa
-                    ReplicaKiller, WorkerKiller,
+                    ReplicaKiller, TornWriteInjector, WorkerKiller,
                     preempt_node_processes)

@@ -231,6 +231,62 @@ class ReplicaKiller(_KillerThread):
         return self._rng.choice(pids)
 
 
+class TornWriteInjector:
+    """SIGKILLs a saving process mid-shard-write — the torn-write
+    chaos the crash-atomic checkpoint commit exists for.  A watcher
+    thread polls the run directory for an in-progress staging dir
+    (``checkpoint_*.tmp/``) containing at least ``min_files`` data
+    files, then kills the target pid dead, leaving exactly the
+    half-written state a preemption SIGKILL at the grace deadline
+    leaves.  ``find_latest_in``/restore must then land on the last
+    COMMITTED checkpoint and ``rt doctor`` must name the torn dir."""
+
+    def __init__(self, run_dir: str, pid: int,
+                 min_files: int = 1, poll_s: float = 0.002):
+        self.run_dir = run_dir
+        self.pid = pid
+        self.min_files = min_files
+        self._poll = poll_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self.killed_at: Optional[str] = None  # the tmp dir we tore
+
+    def start(self) -> "TornWriteInjector":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def _staging_files(self):
+        import glob
+
+        for tmp in glob.glob(os.path.join(self.run_dir,
+                                          "checkpoint_*.tmp")):
+            files = glob.glob(os.path.join(tmp, "shard_*", "*.npy")) \
+                + glob.glob(os.path.join(tmp, "*.msgpack")) \
+                + glob.glob(os.path.join(tmp, "shard_*", "*.npy.tmp"))
+            if len(files) >= self.min_files:
+                return tmp
+        return None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            try:
+                tmp = self._staging_files()
+            except OSError:
+                continue
+            if tmp is None:
+                continue
+            try:
+                os.kill(self.pid, signal.SIGKILL)
+                self.killed_at = tmp
+            except (ProcessLookupError, PermissionError):
+                pass
+            return
+
+
 class WorkerKiller(_KillerThread):
     """Kills a random live worker process of the given agents (ref:
     WorkerKillerActor — kills the process executing a task, exercising

@@ -15,7 +15,10 @@ from .session import (checkpoint_dir, checkpoint_on_notice,  # noqa
                       data_wait, get_checkpoint, get_dataset_shard,
                       get_local_rank, get_world_rank, get_world_size,
                       interrupted, interruption, iter_device_batches,
-                      report)
+                      load_sharded_checkpoint, report,
+                      save_sharded_checkpoint)
+from .sharded_checkpoint import (load_sharded,  # noqa: F401
+                                 save_sharded, verify_checkpoint)
 from .trainer import (DataParallelTrainer, JaxTrainer,  # noqa: F401
                       TorchTrainer)
 from .worker_group import PreemptionError, WorkerGroup  # noqa: F401

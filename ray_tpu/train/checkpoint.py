@@ -5,7 +5,15 @@ python/ray/train/_checkpoint.py) and the StorageContext upload/download
 plumbing (train/_internal/storage.py).  Local filesystem paths are the
 baseline; to_directory/as_directory copy or expose the payload.  Model
 state serialization for jax pytrees rides msgpack via flax.serialization
-(orbax integration is a drop-in upgrade at the call site).
+for the single-blob path; the sharded crash-atomic format lives in
+``sharded_checkpoint.py`` and is exposed here through
+``Checkpoint.is_sharded``/``load_sharded``.
+
+Durability contract (shared with the sharded plane): every write path
+stages into a temp name and commits with one ``os.replace``; a
+directory counts as a checkpoint only once it carries the commit
+marker (or a sharded ``manifest.json``), so ``find_latest_in`` can
+never resume from the torn half of a save a SIGKILL interrupted.
 """
 
 from __future__ import annotations
@@ -17,16 +25,26 @@ import tempfile
 from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
+# The commit-marker/manifest discipline lives jax-free in
+# util/checkpoint_fs (shared with `rt doctor` / `rt checkpoint`);
+# re-exported here because train code historically imports it from
+# this module.
+from ..util.checkpoint_fs import (COMMIT_MARKER,  # noqa: F401
+                                  atomic_write, is_committed,
+                                  mark_committed, scan_run_dir)
+
 
 @contextmanager
-def _timed_ckpt(metric: str):
+def _timed_ckpt(metric: str, sharded: bool = False):
     """Attribute checkpoint I/O to the goodput ledger and observe its
-    duration histogram (save vs restore)."""
+    duration histogram (save vs restore, sharded vs blob)."""
     from ..util import goodput
 
     with goodput.timed_phase(
             "checkpoint", metric,
-            "Checkpoint payload save/restore duration."):
+            "Checkpoint payload save/restore duration.",
+            tags={"sharded": "1" if sharded else "0"},
+            tag_keys=("sharded",)):
         yield
 
 
@@ -48,15 +66,44 @@ class Checkpoint:
     def as_directory(self):
         yield self.path
 
+    # -- sharded-format bridge -------------------------------------------
+    @property
+    def is_sharded(self) -> bool:
+        from .sharded_checkpoint import is_sharded_checkpoint
+
+        return is_sharded_checkpoint(self.path)
+
+    def load_sharded(self, *, mesh=None, specs=None, target=None,
+                     validate: bool = True) -> Any:
+        """Restore this (sharded-format) checkpoint, resharding onto
+        ``mesh`` — see ``sharded_checkpoint.load_sharded``."""
+        from .sharded_checkpoint import load_sharded
+
+        return load_sharded(self.path, mesh=mesh, specs=specs,
+                            target=target, validate=validate)
+
+    def manifest_meta(self) -> Dict[str, Any]:
+        """User metadata stored in a sharded checkpoint's manifest
+        (e.g. the training step), or {} for blob checkpoints."""
+        from .sharded_checkpoint import read_manifest
+
+        try:
+            return dict(read_manifest(self.path).get("meta") or {})
+        except Exception:
+            return {}
+
     # -- convenience jax pytree payloads ---------------------------------
     def save_pytree(self, name: str, tree: Any) -> None:
         from flax import serialization
 
         with _timed_ckpt("rt_train_checkpoint_save_seconds"):
             os.makedirs(self.path, exist_ok=True)
-            with open(os.path.join(self.path, name + ".msgpack"),
-                      "wb") as f:
-                f.write(serialization.to_bytes(tree))
+            # Stage + atomic rename: a SIGKILL mid-write must never
+            # leave a truncated msgpack under the committed name (the
+            # torn-checkpoint failure the drain plane's save race
+            # made likely).
+            atomic_write(os.path.join(self.path, name + ".msgpack"),
+                         serialization.to_bytes(tree))
 
     def load_pytree(self, name: str, target: Any = None) -> Any:
         from flax import serialization
@@ -71,8 +118,8 @@ class Checkpoint:
 
     def save_json(self, name: str, obj: Dict) -> None:
         os.makedirs(self.path, exist_ok=True)
-        with open(os.path.join(self.path, name + ".json"), "w") as f:
-            json.dump(obj, f)
+        atomic_write(os.path.join(self.path, name + ".json"),
+                     json.dumps(obj))
 
     def load_json(self, name: str) -> Dict:
         with open(os.path.join(self.path, name + ".json")) as f:
@@ -99,18 +146,55 @@ class CheckpointManager:
 
     def register(self, source_dir: str,
                  metrics: Optional[Dict] = None) -> Checkpoint:
-        self._index += 1
-        dest = os.path.join(self.run_dir,
-                            f"checkpoint_{self._index:06d}")
-        if os.path.abspath(source_dir) != dest:
+        source = os.path.abspath(source_dir)
+        adopted = self._try_adopt(source)
+        if adopted is not None:
+            dest, idx = adopted
+        else:
+            self._index += 1
+            idx = self._index
+            dest = os.path.join(self.run_dir,
+                                f"checkpoint_{idx:06d}")
             with _timed_ckpt("rt_train_checkpoint_save_seconds"):
-                shutil.copytree(source_dir, dest, dirs_exist_ok=True)
+                # Two-phase: copy into a staging dir, mark it
+                # committed, then one atomic rename — a crash
+                # mid-copytree leaves only an ignorable *.tmp.
+                stage = dest + ".tmp"
+                shutil.rmtree(stage, ignore_errors=True)
+                shutil.copytree(source, stage)
+                mark_committed(stage)
+                if os.path.isdir(dest):
+                    shutil.rmtree(dest, ignore_errors=True)
+                os.replace(stage, dest)
         score = None
         if self.score_attribute and metrics:
             score = metrics.get(self.score_attribute)
-        self._entries.append((score, self._index, dest))
+        # Re-registering the same adopted dir (a re-save of the same
+        # step after an elastic restart) must not leave two entries
+        # for one path — _prune would "delete the duplicate" and take
+        # the live directory with it.
+        self._entries = [e for e in self._entries if e[2] != dest]
+        self._entries.append((score, idx, dest))
         self._prune()
         return Checkpoint(dest)
+
+    def _try_adopt(self, source: str):
+        """A committed checkpoint already living inside the run dir
+        under a ``checkpoint_*`` name (the sharded save writes in
+        place — every rank contributed, rank 0 committed) is adopted
+        as-is instead of being copied onto itself."""
+        if os.path.dirname(source) != os.path.abspath(self.run_dir):
+            return None
+        name = os.path.basename(source)
+        if not name.startswith("checkpoint_") or \
+                not is_committed(source):
+            return None
+        try:
+            idx = int(name.split("_", 1)[1])
+        except ValueError:
+            idx = self._index + 1
+        self._index = max(self._index, idx)
+        return source, idx
 
     def _prune(self) -> None:
         if self.num_to_keep is None or \
@@ -130,18 +214,29 @@ class CheckpointManager:
         self._entries = ranked[: self.num_to_keep]
 
     def latest(self) -> Optional[Checkpoint]:
-        if not self._entries:
-            return None
-        latest = max(self._entries, key=lambda e: e[1])
-        return Checkpoint(latest[2])
+        """Newest checkpoint whose directory is still committed on
+        disk — an entry that turned torn/missing after registration
+        (disk fault, manual surgery) silently falls back to the one
+        before it rather than wedging the restart loop."""
+        for _score, _idx, path in sorted(self._entries,
+                                         key=lambda e: -e[1]):
+            if is_committed(path):
+                return Checkpoint(path)
+        return None
 
     @staticmethod
     def find_latest_in(run_dir: str) -> Optional[Checkpoint]:
-        """Resume support: locate the newest checkpoint_* dir on disk."""
+        """Resume support: locate the newest COMMITTED checkpoint_*
+        dir on disk — staging (*.tmp) and torn (never-committed) dirs
+        are skipped, falling back to the previous committed one, so a
+        save killed mid-write can never become the resume point."""
         if not os.path.isdir(run_dir):
             return None
-        cands = sorted(d for d in os.listdir(run_dir)
-                       if d.startswith("checkpoint_"))
-        if not cands:
-            return None
-        return Checkpoint(os.path.join(run_dir, cands[-1]))
+        cands = sorted((d for d in os.listdir(run_dir)
+                        if d.startswith("checkpoint_")
+                        and not d.endswith(".tmp")), reverse=True)
+        for name in cands:
+            path = os.path.join(run_dir, name)
+            if is_committed(path):
+                return Checkpoint(path)
+        return None

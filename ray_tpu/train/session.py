@@ -164,6 +164,66 @@ class TrainSession:
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.checkpoint
 
+    # ------------------------------------------- sharded checkpointing
+    def save_sharded_checkpoint(self, tree: Any, *, step: int,
+                                specs: Any = None,
+                                mesh_axes: Optional[Dict[str, int]]
+                                = None,
+                                meta: Optional[Dict] = None,
+                                metrics: Optional[Dict] = None,
+                                report: bool = True,
+                                wait_timeout_s: float = 120.0
+                                ) -> Dict[str, Any]:
+        """Collective sharded save into the run directory: EVERY rank
+        calls this with the same ``step``; each writes only its local
+        shards (jax arrays contribute their device shards, host trees
+        the slices of this rank's mesh coordinates per
+        ``specs``/``mesh_axes``), rank 0 writes the manifest last,
+        commits atomically, and — with ``report`` — ships the
+        committed checkpoint through ``session.report`` so the
+        driver's CheckpointManager adopts it in place (no copy).
+        Restore side: ``load_sharded_checkpoint`` reshards onto
+        whatever world/mesh the elastic restart landed on."""
+        if not self.storage_dir:
+            raise RuntimeError(
+                "sharded checkpointing needs the run storage dir; "
+                "this session was initialized without one")
+        from .sharded_checkpoint import save_sharded
+
+        path = os.path.join(self.storage_dir,
+                            f"checkpoint_{int(step):06d}")
+        m = dict(meta or {})
+        m.setdefault("step", int(step))
+        m.setdefault("world_size", self.world_size)
+        result = save_sharded(
+            path, tree, specs=specs, mesh_axes=mesh_axes,
+            process_index=self.world_rank,
+            process_count=self.world_size, meta=m,
+            wait_timeout_s=wait_timeout_s)
+        if result["committed"] and report:
+            self.report({"step": int(step), **(metrics or {})},
+                        checkpoint=Checkpoint(path))
+        return result
+
+    def load_sharded_checkpoint(self, *, mesh=None, specs: Any = None,
+                                target: Any = None,
+                                validate: bool = True
+                                ) -> Optional[Any]:
+        """Restore the attempt's resume checkpoint (if it is in the
+        sharded format), resharded onto ``mesh`` — the world-M half of
+        an elastic N→M restart.  Returns None when there is no
+        checkpoint; raises if the checkpoint exists but is a blob
+        (use ``get_checkpoint().load_pytree`` for those)."""
+        ckpt = self.get_checkpoint()
+        if ckpt is None:
+            return None
+        if not ckpt.is_sharded:
+            raise ValueError(
+                f"{ckpt.path} is not a sharded checkpoint; load it "
+                f"with Checkpoint.load_pytree/load_json")
+        return ckpt.load_sharded(mesh=mesh, specs=specs,
+                                 target=target, validate=validate)
+
     def get_dataset_shard(self, name: str = "train"):
         shard = self.dataset_shards.get(name)
         if shard is None:
@@ -202,6 +262,25 @@ def get_checkpoint() -> Optional[Checkpoint]:
 
 def get_dataset_shard(name: str = "train"):
     return get_session().get_dataset_shard(name)
+
+
+def save_sharded_checkpoint(tree, *, step: int, specs=None,
+                            mesh_axes=None, meta=None, metrics=None,
+                            report: bool = True,
+                            wait_timeout_s: float = 120.0):
+    """Collective per-rank sharded save (see
+    ``TrainSession.save_sharded_checkpoint``)."""
+    return get_session().save_sharded_checkpoint(
+        tree, step=step, specs=specs, mesh_axes=mesh_axes, meta=meta,
+        metrics=metrics, report=report, wait_timeout_s=wait_timeout_s)
+
+
+def load_sharded_checkpoint(*, mesh=None, specs=None, target=None,
+                            validate: bool = True):
+    """Reshard-on-restore of the attempt's resume checkpoint (see
+    ``TrainSession.load_sharded_checkpoint``)."""
+    return get_session().load_sharded_checkpoint(
+        mesh=mesh, specs=specs, target=target, validate=validate)
 
 
 def get_world_rank() -> int:

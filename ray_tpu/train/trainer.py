@@ -138,7 +138,8 @@ class BaseTrainer:
                     shard_specs[w.rank],
                     self.run_config.name or "train_run",
                     self.run_config.telemetry,
-                    os.environ.get("RT_JOB_ID", "")))
+                    os.environ.get("RT_JOB_ID", ""),
+                    self.run_config.resolved_storage_path()))
             final_metrics: Dict = {}
             pending = list(refs)
             self._drain_notice = None
@@ -341,7 +342,7 @@ class BaseTrainer:
 
 def _worker_entry(train_loop, config, rank, world, local_info, queue,
                   ckpt_path, shards, experiment_name, telemetry=None,
-                  job_id=""):
+                  job_id="", storage_dir=""):
     """Runs inside the worker actor: set up the session, run user code."""
     from . import session as session_mod
     from .checkpoint import Checkpoint
@@ -362,6 +363,7 @@ def _worker_entry(train_loop, config, rank, world, local_info, queue,
         result_queue=queue,
         checkpoint=Checkpoint(ckpt_path) if ckpt_path else None,
         dataset_shards=shards,
+        storage_dir=storage_dir,
         telemetry=telemetry)
     from ..util import flight_recorder
 

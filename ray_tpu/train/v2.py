@@ -219,8 +219,24 @@ class TrainControllerV2:
                 attempt))
             prev = self.trainer.scaling_config.num_workers
             if size != prev and attempt > 0:
+                # A sharded checkpoint reshards transparently onto the
+                # new world; surface the N→M hop (and the saved mesh)
+                # in the state history so an elastic resize is
+                # attributable after the fact.
+                info = {}
+                if start_ckpt is not None:
+                    try:
+                        from .sharded_checkpoint import read_manifest
+
+                        man = read_manifest(start_ckpt.path)
+                        info = {"ckpt_world": man.get("world_size"),
+                                "ckpt_mesh": (man.get("mesh") or
+                                              {}).get("shape")}
+                    except Exception:
+                        pass
                 self._transition(ControllerState.RESIZING,
-                                 from_workers=prev, to_workers=size)
+                                 from_workers=prev, to_workers=size,
+                                 **info)
             self.trainer.scaling_config = replace(
                 self.trainer.scaling_config, num_workers=size)
             self.attempt_sizes.append(size)

@@ -1,0 +1,191 @@
+"""Checkpoint on-disk format primitives — jax-free by design.
+
+The commit protocol and manifest format shared by the blob
+(``train/checkpoint.py``) and sharded (``train/sharded_checkpoint.py``)
+checkpoint planes, extracted here so the CLI (``rt checkpoint
+verify``) and ``rt doctor``'s torn-checkpoint scan never import jax
+through the train package (the util/backoff.py precedent).
+
+Commit protocol: a directory is a checkpoint iff it carries the
+commit marker or a sharded ``manifest.json`` — both are written LAST,
+after every payload byte is fsynced, and the whole directory arrives
+under its final name via one ``os.replace``.  Anything else
+(``*.tmp`` staging dirs, marker-less dirs) is a torn save restore
+must skip.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import zlib
+from typing import Any, Dict, List, Optional
+
+MANIFEST = "manifest.json"
+COMMIT_MARKER = ".rt_committed"
+TMP_SUFFIX = ".tmp"
+FORMAT_VERSION = 1
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A committed checkpoint failed validation (bad checksum, missing
+    shard file, malformed manifest) — the caller should fall back to an
+    earlier committed checkpoint rather than trust this one."""
+
+
+class CheckpointNotCommittedError(RuntimeError):
+    """The directory has no manifest — an uncommitted/torn save."""
+
+
+def crc32_hex(data: bytes) -> str:
+    return format(zlib.crc32(data) & 0xFFFFFFFF, "08x")
+
+
+def atomic_write(path: str, data) -> None:
+    """THE durable-write primitive of the checkpoint planes: stage
+    into ``path + ".tmp"``, flush + fsync, then one ``os.replace``.
+    Every commit-critical file (payloads, shard indexes, manifests,
+    markers) goes through here so the discipline lives — and gets
+    fixed — in exactly one place.  ``data``: bytes or str."""
+    mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+    with open(path + TMP_SUFFIX, mode) as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(path + TMP_SUFFIX, path)
+
+
+def mark_committed(path: str) -> None:
+    """Write the commit marker into a fully-staged checkpoint dir."""
+    atomic_write(os.path.join(path, COMMIT_MARKER), "1")
+
+
+def is_sharded_checkpoint(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, MANIFEST))
+
+
+def is_committed(path: str) -> bool:
+    """A directory restore may trust: carries the commit marker or a
+    sharded manifest, and is not a staging (*.tmp) dir."""
+    if not os.path.isdir(path) or \
+            path.rstrip(os.sep).endswith(TMP_SUFFIX):
+        return False
+    return os.path.isfile(os.path.join(path, COMMIT_MARKER)) or \
+        is_sharded_checkpoint(path)
+
+
+def read_manifest(path: str) -> Dict[str, Any]:
+    mpath = os.path.join(path, MANIFEST)
+    if not os.path.isfile(mpath):
+        raise CheckpointNotCommittedError(
+            f"{path} has no {MANIFEST} — an uncommitted or torn "
+            f"checkpoint directory")
+    try:
+        with open(mpath) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable manifest in {path}: {e}") from e
+
+
+def scan_run_dir(run_dir: str) -> List[Dict[str, Any]]:
+    """Inventory every checkpoint_* entry in a run directory —
+    committed, torn (dir present but never committed), or staging
+    (*.tmp) — for ``rt doctor``'s checkpoint-risk finding and the
+    torn-write chaos tooling."""
+    out: List[Dict[str, Any]] = []
+    if not os.path.isdir(run_dir):
+        return out
+    for name in sorted(os.listdir(run_dir)):
+        if not name.startswith("checkpoint_"):
+            continue
+        path = os.path.join(run_dir, name)
+        if not os.path.isdir(path):
+            continue
+        tmp = name.endswith(TMP_SUFFIX)
+        committed = not tmp and is_committed(path)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = 0.0
+        out.append({"name": name, "path": path, "tmp": tmp,
+                    "committed": committed,
+                    "torn": not tmp and not committed,
+                    "mtime": mtime})
+    return out
+
+
+def verify_checkpoint(path: str) -> Dict[str, Any]:
+    """Full integrity report for one checkpoint directory: commit
+    status, manifest sanity, every shard file present with a matching
+    CRC, and every leaf fully covered by its saved slices.  Powers
+    ``rt checkpoint verify`` and the restore-time fallback decision."""
+    path = os.path.abspath(path)
+    report: Dict[str, Any] = {
+        "path": path, "ok": False, "committed": False,
+        "sharded": False, "errors": [], "leaves": 0, "files": 0,
+        "bytes": 0,
+    }
+    if not os.path.isdir(path):
+        report["errors"].append("not a directory")
+        return report
+    if path.endswith(TMP_SUFFIX):
+        report["errors"].append(
+            "uncommitted staging directory (*.tmp) — a save was "
+            "interrupted before its commit rename")
+        return report
+    if not is_sharded_checkpoint(path):
+        if os.path.isfile(os.path.join(path, COMMIT_MARKER)):
+            report.update(ok=True, committed=True)
+            report["files"] = sum(len(fs) for _, _, fs
+                                  in os.walk(path))
+            return report
+        report["errors"].append(
+            f"no {MANIFEST} or commit marker — torn/uncommitted "
+            f"checkpoint directory")
+        return report
+    report["sharded"] = True
+    try:
+        manifest = read_manifest(path)
+    except (CheckpointCorruptError,
+            CheckpointNotCommittedError) as e:
+        report["errors"].append(str(e))
+        return report
+    report["committed"] = True
+    report["world_size"] = manifest.get("world_size")
+    report["mesh"] = (manifest.get("mesh") or {}).get("shape")
+    report["leaves"] = len(manifest.get("leaves") or {})
+    covered: Dict[str, int] = {}
+    for ent in manifest.get("files", []):
+        report["files"] += 1
+        fpath = os.path.join(path, ent["file"])
+        if not os.path.exists(fpath):
+            report["errors"].append(f"missing shard file "
+                                    f"{ent['file']}")
+            continue
+        try:
+            with open(fpath, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            report["errors"].append(f"unreadable {ent['file']}: {e}")
+            continue
+        report["bytes"] += len(data)
+        crc = crc32_hex(data)
+        if crc != ent.get("crc32"):
+            report["errors"].append(
+                f"checksum mismatch in {ent['file']} "
+                f"(manifest {ent.get('crc32')}, file {crc})")
+        n = 1
+        for lo, hi in ent.get("index", []):
+            n *= max(hi - lo, 0)
+        covered[ent["leaf"]] = covered.get(ent["leaf"], 0) + n
+    for name, info in (manifest.get("leaves") or {}).items():
+        want = max(math.prod(info.get("shape") or []), 1)
+        # Replicated slices over-cover; under-coverage is the error.
+        if covered.get(name, 0) < want:
+            report["errors"].append(
+                f"leaf {name!r}: saved slices cover "
+                f"{covered.get(name, 0)}/{want} elements")
+    report["ok"] = not report["errors"]
+    return report

@@ -603,6 +603,73 @@ def find_starved_jobs(pgs: List[Dict], now: float,
     return out
 
 
+def find_checkpoint_risk(scans: List[Dict],
+                         save_stats: Optional[Dict],
+                         grace_s: float, now: Optional[float] = None,
+                         stale_tmp_s: float = 120.0) -> List[Dict]:
+    """Checkpoint durability risks:
+
+    - **torn dirs** — a ``checkpoint_*`` directory in a run dir that
+      never committed (no manifest/commit marker): a save died
+      mid-write.  Restore provably skips it, but it is disk the
+      operator should reap and a signal saves are being interrupted.
+      Abandoned ``*.tmp`` staging dirs older than ``stale_tmp_s``
+      count too.
+    - **save slower than the grace window** — the cluster's observed
+      checkpoint-save p99 exceeding ``RT_PREEMPTION_GRACE_S`` is
+      CRITICAL: a checkpoint-on-notice raced against a preemption
+      deadline cannot finish, so every preemption becomes an
+      unannounced loss of progress.
+
+    ``scans``: [{"run_dir": ..., "entries": [scan_run_dir rows]}];
+    ``save_stats``: {"p99": s, "count": n} merged from
+    ``rt_train_checkpoint_save_seconds`` across sources."""
+    now = time.time() if now is None else now
+    out = []
+    for scan in scans or []:
+        run_dir = scan.get("run_dir", "?")
+        for ent in scan.get("entries", []):
+            stale_tmp = ent.get("tmp") and \
+                now - ent.get("mtime", now) > stale_tmp_s
+            if not ent.get("torn") and not stale_tmp:
+                continue
+            kind = "abandoned staging dir" if ent.get("tmp") \
+                else "torn (uncommitted) checkpoint dir"
+            out.append(_finding(
+                "torn_checkpoint", "warning",
+                f"{kind} {ent.get('name')} in {run_dir}",
+                detail="a checkpoint save died before its commit "
+                       "rename — restore falls back to the previous "
+                       "committed checkpoint, but the directory "
+                       "wastes disk and means a save was killed "
+                       "mid-write (check the preemption grace vs "
+                       "save duration).",
+                probe=f"rt checkpoint verify {ent.get('path')}; "
+                      f"rm -r it once confirmed torn",
+                data={"run_dir": run_dir, **{k: ent.get(k) for k in
+                      ("name", "path", "tmp", "torn", "mtime")}}))
+    stats = save_stats or {}
+    p99 = float(stats.get("p99") or 0.0)
+    if stats.get("count") and grace_s > 0 and p99 > grace_s:
+        out.append(_finding(
+            "checkpoint_exceeds_grace", "critical",
+            f"checkpoint save p99 {p99:.1f}s exceeds the "
+            f"{grace_s:.0f}s preemption grace window",
+            detail="a checkpoint-on-notice raced against a "
+                   "preemption deadline cannot fit: the node will be "
+                   "SIGKILLed mid-save and the run restarts from an "
+                   "older checkpoint, losing the progress the drain "
+                   "plane exists to protect.  Shard the checkpoint "
+                   "across ranks (train.save_sharded_checkpoint), "
+                   "save more often, or raise "
+                   "RT_PREEMPTION_GRACE_S if the provider allows.",
+            probe="rt telemetry (ckpt save histogram); "
+                  "RT_PREEMPTION_GRACE_S",
+            data={"save_p99_s": p99, "grace_s": grace_s,
+                  "saves_observed": stats.get("count", 0)}))
+    return out
+
+
 def find_autoscaler_gaps(decisions: List[Dict], now: float,
                          horizon_s: float = 300.0) -> List[Dict]:
     """Recent autoscaler ticks that saw demand no launchable node
@@ -661,7 +728,9 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
              stuck_task_min_s: float = 60.0,
              stuck_task_p99_factor: float = 3.0,
              straggler_threshold: float = 0.2,
-             starvation_warn_s: float = 60.0) -> Dict[str, Any]:
+             starvation_warn_s: float = 60.0,
+             checkpoints: Optional[Dict] = None,
+             preemption_grace_s: float = 30.0) -> Dict[str, Any]:
     """Pure aggregation of every check over already-fetched state
     (unit-testable without a cluster)."""
     now = time.time() if now is None else now
@@ -685,6 +754,9 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
                                       tasks=tasks, now=now)
     findings += find_autoscaler_gaps(
         feed.get("autoscaler_decisions") or [], now)
+    findings += find_checkpoint_risk(
+        (checkpoints or {}).get("scans") or [],
+        (checkpoints or {}).get("save"), preemption_grace_s, now=now)
     findings += find_flight_dumps(feed.get("flight") or [], now)
     findings.sort(key=lambda f: _SEV_ORDER.get(f["severity"], 9))
     return {
@@ -705,10 +777,43 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
     }
 
 
-def cluster_diagnosis(*, address: Optional[str] = None
+def _checkpoint_save_stats(sources: Dict[str, List[Dict]]
+                           ) -> Optional[Dict[str, Any]]:
+    """Merge the cluster's ``rt_train_checkpoint_save_seconds``
+    histograms (every source, every ``sharded`` tag) into one
+    {count, p99} — the grace-window check's input."""
+    from .telemetry import _hist_quantile
+
+    count = 0
+    buckets: List[int] = []
+    boundaries: List[float] = []
+    for snaps in (sources or {}).values():
+        for snap in snaps:
+            if snap.get("name") != "rt_train_checkpoint_save_seconds":
+                continue
+            boundaries = snap.get("boundaries") or boundaries
+            for s in snap.get("series", []):
+                h = s.get("hist") or {}
+                count += int(h.get("count", 0))
+                bk = h.get("buckets") or []
+                if len(buckets) < len(bk):
+                    buckets += [0] * (len(bk) - len(buckets))
+                for i, c in enumerate(bk):
+                    buckets[i] += c
+    if not count:
+        return None
+    return {"count": count,
+            "p99": _hist_quantile(boundaries, buckets, count, 0.99)}
+
+
+def cluster_diagnosis(*, address: Optional[str] = None,
+                      run_dir: Optional[str] = None
                       ) -> Dict[str, Any]:
     """Assemble the full diagnosis from a live controller + agents
-    (the `rt doctor` / /api/doctor entry point)."""
+    (the `rt doctor` / /api/doctor entry point).  ``run_dir`` opts a
+    training run directory into the torn-checkpoint scan (the save
+    p99 vs. preemption-grace check runs regardless, from cluster
+    telemetry)."""
     from ..core.config import RuntimeConfig
     from . import state as state_api
 
@@ -731,6 +836,18 @@ def cluster_diagnosis(*, address: Optional[str] = None
         serve = state_api.serve_resilience(address=address)
     except Exception:
         serve = {}
+    checkpoints: Dict[str, Any] = {}
+    try:
+        raw = state_api.telemetry(address=address)
+        checkpoints["save"] = _checkpoint_save_stats(
+            raw.get("sources") or {})
+    except Exception:
+        pass
+    if run_dir:
+        from .checkpoint_fs import scan_run_dir
+
+        checkpoints["scans"] = [{"run_dir": run_dir,
+                                 "entries": scan_run_dir(run_dir)}]
     return diagnose(
         feed=feed, tasks=tasks, spans=spans, load=load, pgs=pgs,
         nodes=nodes, ledgers=ledgers, serve=serve,
@@ -742,7 +859,9 @@ def cluster_diagnosis(*, address: Optional[str] = None
         stuck_task_min_s=config.stuck_task_min_s,
         stuck_task_p99_factor=config.stuck_task_p99_factor,
         straggler_threshold=config.straggler_threshold,
-        starvation_warn_s=config.starvation_warn_s)
+        starvation_warn_s=config.starvation_warn_s,
+        checkpoints=checkpoints,
+        preemption_grace_s=config.preemption_grace_s)
 
 
 def render_text(diag: Dict[str, Any]) -> str:

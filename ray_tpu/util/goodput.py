@@ -209,15 +209,29 @@ def reset() -> GoodputLedger:
     return _ledger
 
 
+def current_phase() -> Optional[str]:
+    """Name of the phase currently on top of the global ledger's
+    stack, or None.  Lets nested attributors (the sharded checkpoint
+    save inside a ``checkpoint_on_notice`` block) avoid stealing the
+    outer phase's wall-clock."""
+    led = _ledger
+    if led is None:
+        return None
+    with led._lock:
+        return led._stack[-1][0] if led._stack else None
+
+
 @contextmanager
 def timed_phase(phase: str, metric: Optional[str] = None,
-                description: str = ""):
+                description: str = "", tags: Optional[Dict] = None,
+                tag_keys: tuple = ()):
     """Attribute a block to a goodput phase and (optionally) observe
     its duration histogram — the shared shape behind
     ``train.data_wait`` and checkpoint save/restore timing.  Ledger
     attribution covers the block even when it raises; the histogram
     observes only on success (a failed wait/save has no meaningful
-    duration sample)."""
+    duration sample).  ``tags``/``tag_keys`` thread through to the
+    histogram (e.g. the checkpoint plane's ``sharded`` tag)."""
     t0 = time.monotonic()
     with ledger().phase(phase):
         yield
@@ -225,8 +239,9 @@ def timed_phase(phase: str, metric: Optional[str] = None,
         try:
             from .metrics import Histogram
 
-            Histogram(metric, description).observe(
-                time.monotonic() - t0)
+            Histogram(metric, description,
+                      tag_keys=tag_keys or tuple(tags or ())).observe(
+                time.monotonic() - t0, tags=tags)
         except Exception:
             pass  # telemetry must never fail the training path
 

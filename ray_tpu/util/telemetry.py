@@ -40,6 +40,20 @@ def _hist_stats(boundaries: List[float], hist: Dict) -> Dict[str, float]:
     return out
 
 
+def _merge_hist_stats(cur: Optional[Dict[str, float]],
+                      new: Dict[str, float]) -> Dict[str, float]:
+    """Merge hist stats across series/sources: exact for count/sum/
+    mean, conservative (max) for the quantile bounds."""
+    if not cur:
+        return dict(new)
+    n = cur["count"] + new["count"]
+    total = cur["sum"] + new["sum"]
+    return {"count": n, "sum": total,
+            "mean": (total / n) if n else 0.0,
+            "p50": max(cur["p50"], new["p50"]),
+            "p99": max(cur["p99"], new["p99"])}
+
+
 def _hist_quantile(boundaries: List[float], buckets: List[int],
                    count: int, q: float) -> float:
     """Upper-bound estimate of the q-quantile from bucket counts (the
@@ -89,8 +103,15 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
     llm = {"kv_pages_used": 0.0, "kv_pages_total": 0.0,
            "batch_size": 0.0, "waiting": 0.0, "tokens": 0.0,
            "prefill_tokens": 0.0, "evictions": 0.0, "engines": 0}
+    checkpoints: Dict[str, Any] = {"bytes": 0.0, "shards": 0.0,
+                                   "save": {}, "restore": {}}
     for src, snap in _iter_metrics(sources):
         name = snap.get("name", "")
+        if name in ("rt_checkpoint_bytes", "rt_checkpoint_shards"):
+            key = "bytes" if name.endswith("bytes") else "shards"
+            for s in snap.get("series", []):
+                checkpoints[key] += float(s.get("value", 0.0))
+            continue
         if name.startswith("rt_llm_"):
             key = {"rt_llm_kv_pages_used": "kv_pages_used",
                    "rt_llm_kv_pages_total": "kv_pages_total",
@@ -147,8 +168,18 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
         elif name in TRAIN_HISTS:
             row = train.setdefault(src, {})
             for s in snap.get("series", []):
-                row[name] = _hist_stats(snap.get("boundaries", []),
-                                        s.get("hist", {}))
+                stats = _hist_stats(snap.get("boundaries", []),
+                                    s.get("hist", {}))
+                # The sharded-checkpoint tag splits save/restore into
+                # multiple series; the per-source train row merges
+                # them, the Checkpoints section keeps them apart.
+                row[name] = _merge_hist_stats(row.get(name), stats)
+                if "checkpoint" in name:
+                    kind = "save" if "save" in name else "restore"
+                    tag = "sharded" if (s.get("tags") or {}).get(
+                        "sharded") == "1" else "blob"
+                    checkpoints[kind][tag] = _merge_hist_stats(
+                        checkpoints[kind].get(tag), stats)
         elif name == "rt_collective_latency_seconds":
             for s in snap.get("series", []):
                 tags = s.get("tags") or {}
@@ -221,6 +252,7 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
         "object_store": object_store,
         "worker_pool": worker_pool,
         "llm": llm,
+        "checkpoints": checkpoints,
         "flight": raw.get("flight", []),
     }
 
@@ -361,6 +393,24 @@ def render_text(summary: Dict[str, Any]) -> str:
         if llm.get("evictions"):
             lines.append(f"  evictions      {llm['evictions']:.0f} "
                          "(KV-pressure recompute preemptions)")
+
+    ck = summary.get("checkpoints") or {}
+    if ck.get("bytes") or ck.get("save") or ck.get("restore"):
+        lines.append("\nCheckpoints:")
+        if ck.get("bytes") or ck.get("shards"):
+            lines.append(
+                f"  last save     {_fmt_rate(ck.get('bytes', 0.0))}B "
+                f"in {ck.get('shards', 0):.0f} shard file(s) "
+                f"(summed across writers)")
+        for kind in ("save", "restore"):
+            for tag in sorted(ck.get(kind) or {}):
+                h = ck[kind][tag]
+                if not h.get("count"):
+                    continue
+                lines.append(
+                    f"  {kind:<7} {tag:<8} n={h['count']}  mean "
+                    f"{h['mean'] * 1e3:.1f}ms  "
+                    f"p99≤{h['p99'] * 1e3:.1f}ms")
 
     pool = summary.get("worker_pool") or {}
     if pool.get("target") or pool.get("adoptions") \

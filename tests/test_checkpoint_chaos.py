@@ -1,0 +1,325 @@
+"""Elastic checkpoint plane acceptance (ISSUE 10) — slow+chaos.
+
+Two scenarios on a live two-node cluster:
+
+1. **Elastic N→M resume.**  A world-2 training gang is preempted
+   mid-run by ``PreemptionKiller``; checkpoint-on-notice produces a
+   COMMITTED sharded checkpoint (each rank wrote only its own shard);
+   the run resumes at world 1 with a different mesh, the restored
+   params are bit-identical to the saved state, and
+   ``FailureConfig.max_failures`` (= 0) is not consumed.
+
+2. **Torn write.**  A SIGKILL mid-shard-write (``TornWriteInjector``)
+   never corrupts resume: the staging dir is ignored,
+   ``find_latest_in``/restore land on the last committed checkpoint,
+   and ``rt doctor --run-dir`` names the torn directory.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ENV = {
+    "RT_METRICS_REPORT_PERIOD_S": "0.5",
+    "RT_RAYLET_HEARTBEAT_PERIOD_MS": "300",   # fast death detection
+    "RT_PREEMPTION_GRACE_S": "5",             # SIGTERM drain window
+    "RT_RESTART_BACKOFF_BASE_S": "0.3",
+    "RT_RESTART_BACKOFF_MAX_S": "1.0",
+    "RT_RESTART_BACKOFF_JITTER": "0.25",
+}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    old = {k: os.environ.get(k) for k in _ENV}
+    os.environ.update(_ENV)
+    c = Cluster(head_node_args={"num_cpus": 3})
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.address)
+    c.wait_for_nodes()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _rt(*args, timeout=90):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", *args],
+        capture_output=True, text=True, env=env, timeout=timeout)
+
+
+def _wait(pred, timeout=60, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.2)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def _base_params():
+    """Deterministic param tree every rank derives identically — the
+    bit-identity oracle for save/reshard/restore."""
+    import numpy as np
+
+    w = (np.outer(np.arange(48, dtype=np.float64),
+                  np.arange(16, dtype=np.float64)) / 7.0
+         + 0.25).astype(np.float32)
+    b = np.arange(16, dtype=np.float32) * 0.125 + 1.0
+    return {"w": w, "b": b}
+
+
+def _elastic_loop(config):
+    """World-2 phase: run until preempted, then EVERY rank writes its
+    own shard of the params (no gather) via checkpoint-on-notice.
+    World-1 resume phase: reshard-restore the full tree, assert
+    bit-identity, and finish the step budget."""
+    import time as _time
+
+    import numpy as np
+
+    from ray_tpu import train
+
+    world = train.get_world_size()
+    rank = train.get_world_rank()
+    params = _base_params()
+    start = 0
+    extra = {}
+    ckpt = train.get_checkpoint()
+    if ckpt is not None and ckpt.is_sharded:
+        meta = ckpt.manifest_meta()
+        start = int(meta["step"])
+        restored = ckpt.load_sharded()  # world-M (=1) full restore
+        exp = _base_params()
+        ok = (np.array_equal(restored["w"], exp["w"])
+              and np.array_equal(restored["b"], exp["b"]))
+        assert ok, "restored params are not bit-identical"
+        extra = {"restored_ok": True,
+                 "from_world": int(meta.get("world_size", -1))}
+    saved_notice = False
+    for step in range(start, config["steps"]):
+        _time.sleep(0.2)
+        metrics = {"step": step, "start": start, "world": world,
+                   **extra}
+        if world > 1 and train.interrupted() and not saved_notice:
+            saved_notice = True
+            with train.checkpoint_on_notice():
+                # Collective sharded save: rank r writes only its
+                # w-rows; rank 0 commits and reports.  The fixed
+                # step tag gives every rank the same directory name
+                # (their local step counters may be skewed by the
+                # interrupt-poll throttle).
+                train.save_sharded_checkpoint(
+                    params, step=900000,
+                    specs={"w": ["fsdp"], "b": []},
+                    mesh_axes={"fsdp": world},
+                    meta={"step": step, "world_size": world},
+                    metrics={**metrics, "notice": True},
+                    wait_timeout_s=20.0)
+        else:
+            train.report(metrics)
+        if rank == 0 or world == 1:
+            with open(config["progress"], "w") as f:
+                f.write(str(step))
+    return start
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_elastic_sharded_checkpoint_survives_preemption(
+        cluster, tmp_path):
+    from ray_tpu.testing.chaos import PreemptionKiller
+    from ray_tpu.train import (ElasticScalingPolicy, FailurePolicy,
+                               RunConfig, ScalingConfig,
+                               TrainControllerV2)
+    from ray_tpu.train.backend import Backend
+    from ray_tpu.train.trainer import BaseTrainer
+
+    progress = str(tmp_path / "progress")
+    trainer = BaseTrainer(
+        _elastic_loop,
+        train_loop_config={"steps": 40, "progress": progress},
+        scaling_config=ScalingConfig(
+            num_workers=2, resources_per_worker={"CPU": 2.0},
+            placement_strategy="STRICT_SPREAD"),
+        run_config=RunConfig(name="elastic_ckpt",
+                             storage_path=str(tmp_path)))
+    trainer.backend_cls = Backend
+    controller = TrainControllerV2(
+        trainer,
+        scaling_policy=ElasticScalingPolicy(
+            min_workers=1, max_workers=2,
+            resources_per_worker={"CPU": 2.0}),
+        failure_policy=FailurePolicy(max_failures=0))
+
+    side = {}
+
+    def arm_killer():
+        try:
+            _wait(lambda: os.path.exists(progress)
+                  and int(open(progress).read() or 0) >= 3,
+                  timeout=90, what="training progress")
+            killer = PreemptionKiller(cluster, interval_s=0.5,
+                                      grace_s=4.0, max_kills=1)
+            side["killer"] = killer.start()
+        except Exception as e:
+            side["error"] = repr(e)
+
+    t = threading.Thread(target=arm_killer, daemon=True)
+    t.start()
+    result = controller.fit()
+    t.join(timeout=30)
+    killer = side.get("killer")
+    if killer is not None:
+        killer.stop()
+    assert "error" not in side, side["error"]
+    assert killer is not None and killer.kills, "no preemption fired"
+
+    # Finished despite max_failures=0: the loss was ANNOUNCED.
+    assert result.error is None, result.error
+    assert controller.announced_failures == 1
+    assert controller.attempt_sizes[0] == 2
+    assert controller.attempt_sizes[-1] == 1, controller.attempt_sizes
+
+    # The notice save committed a SHARDED checkpoint from world 2.
+    notices = [h for h in result.metrics_history
+               if h["metrics"].get("notice")]
+    assert notices, "no checkpoint-on-notice was reported"
+    assert notices[0].get("preempt_ckpt"), notices[0]
+    notice_step = notices[0]["metrics"]["step"]
+    ckpt_dir = notices[0]["checkpoint_path"]
+    assert os.path.basename(ckpt_dir) == "checkpoint_900000"
+    from ray_tpu.util.checkpoint_fs import verify_checkpoint
+
+    report = verify_checkpoint(ckpt_dir)
+    assert report["ok"] and report["sharded"], report
+    assert report["world_size"] == 2
+    # Rank 1 genuinely contributed its own shard (no rank-0 gather).
+    assert os.path.isdir(os.path.join(ckpt_dir, "shard_1"))
+
+    # The world-1 resume restored bit-identically from it.
+    resumed = [h for h in result.metrics_history
+               if h["metrics"].get("start")]
+    assert resumed, result.metrics_history
+    assert all(h["metrics"]["restored_ok"] for h in resumed)
+    assert all(h["metrics"]["from_world"] == 2 for h in resumed)
+    assert all(h["metrics"]["world"] == 1 for h in resumed)
+    starts = {h["metrics"]["start"] for h in result.metrics_history}
+    assert starts == {0, notice_step}, (starts, notice_step)
+    assert max(h["metrics"]["step"]
+               for h in result.metrics_history) == 39
+
+    # The controller's state history attributes the elastic hop to
+    # the sharded checkpoint (RESIZING carries the saved world/mesh).
+    resizes = [s for s in controller.state_history
+               if s["state"] == "RESIZING"]
+    assert any(s.get("ckpt_world") == 2 for s in resizes), resizes
+
+    # Reshard-on-restore ALSO works onto a real device mesh that
+    # never existed during training (world 2 hosts -> one process,
+    # 4-way fsdp over virtual CPU devices).
+    import jax
+    from jax.sharding import Mesh
+
+    from ray_tpu.train.sharded_checkpoint import load_sharded
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4,), ("fsdp",))
+    out = load_sharded(ckpt_dir, mesh=mesh)
+    exp = _base_params()
+    assert np.array_equal(np.asarray(out["w"]), exp["w"])
+    assert np.array_equal(np.asarray(out["b"]), exp["b"])
+    assert str(out["w"].sharding.spec) == "PartitionSpec('fsdp',)"
+
+
+_TORN_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+from ray_tpu.train.sharded_checkpoint import save_sharded
+# ~25 MB over 200 files: long enough that a SIGKILL lands mid-write.
+tree = {{f"layer_{{i:03d}}": np.full((128, 256), float(i), np.float32)
+        for i in range(200)}}
+save_sharded(sys.argv[1] + "/checkpoint_000002", tree)
+print("COMMITTED")  # must never be reached
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_torn_write_never_corrupts_resume(cluster, tmp_path):
+    from ray_tpu.testing.chaos import TornWriteInjector
+    from ray_tpu.train.checkpoint import CheckpointManager
+    from ray_tpu.train.sharded_checkpoint import (load_sharded,
+                                                  save_sharded)
+    from ray_tpu.util.checkpoint_fs import scan_run_dir
+
+    run = str(tmp_path / "run")
+    os.makedirs(run)
+    tree = _base_params()
+    save_sharded(os.path.join(run, "checkpoint_000001"), tree,
+                 specs={"w": ["fsdp"], "b": []},
+                 mesh_axes={"fsdp": 2}, meta={"step": 11})
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    child = subprocess.Popen(
+        [sys.executable, "-c", _TORN_CHILD.format(repo=REPO), run],
+        env=env, stdout=subprocess.PIPE, text=True)
+    inj = TornWriteInjector(run, child.pid, min_files=2).start()
+    out, _ = child.communicate(timeout=120)
+    inj.stop()
+    assert child.returncode == -9, (child.returncode, out)
+    assert "COMMITTED" not in (out or "")
+    assert inj.killed_at, "injector never saw the staging dir"
+
+    # The commit never happened: no final dir, only staging debris.
+    assert not os.path.isdir(os.path.join(run, "checkpoint_000002"))
+    staging = os.path.join(run, "checkpoint_000002.tmp")
+    assert os.path.isdir(staging)
+
+    # Resume provably lands on the last COMMITTED checkpoint.
+    latest = CheckpointManager.find_latest_in(run)
+    assert latest is not None
+    assert os.path.basename(latest.path) == "checkpoint_000001"
+    assert latest.manifest_meta()["step"] == 11
+    restored = load_sharded(latest.path)
+    assert np.array_equal(restored["w"], tree["w"])
+    assert np.array_equal(restored["b"], tree["b"])
+
+    # Backdate the staging dir past the in-flight window: rt doctor
+    # (against the live cluster, with the run-dir scan) names it.
+    os.utime(staging, (time.time() - 600, time.time() - 600))
+    entries = scan_run_dir(run)
+    assert any(e["tmp"] for e in entries), entries
+    d = _rt("doctor", "--format", "json", "--run-dir", run,
+            "--address", cluster.address)
+    diag = json.loads(d.stdout or "{}")
+    torn = [f for f in diag.get("findings", [])
+            if f["check"] == "torn_checkpoint"]
+    assert torn, diag.get("findings")
+    assert any("checkpoint_000002.tmp" in f["summary"]
+               for f in torn), torn
+
+    # `rt checkpoint verify` agrees, offline.
+    r = _rt("checkpoint", "verify", staging)
+    assert r.returncode == 1
+    assert "staging" in r.stdout or "torn" in r.stdout
