@@ -467,6 +467,10 @@ def cmd_checkpoint_verify(args) -> int:
         return 0 if report["ok"] else 1
     status = "OK (committed)" if report["ok"] else (
         "CORRUPT" if report["committed"] else "NOT COMMITTED (torn)")
+    if report.get("aside"):
+        status += (" — aside copy from an interrupted re-save swap; "
+                   "if its final name is missing, `mv` it back to "
+                   "recover" if report["ok"] else "")
     print(f"{report['path']}: {status}")
     if report.get("sharded"):
         mesh = report.get("mesh") or {}
@@ -496,8 +500,15 @@ def cmd_checkpoint_list(args) -> int:
         print(f"no checkpoint_* entries in {args.run_dir}")
         return 0
     for e in entries:
-        state = ("staging" if e["tmp"]
-                 else "committed" if e["committed"] else "TORN")
+        if e.get("old"):
+            # Aside copy from a re-save swap; "RECOVERABLE" means its
+            # content is committed and can be renamed back if the
+            # final name never re-appeared (rt doctor flags that).
+            state = ("aside (RECOVERABLE)" if e.get("recoverable")
+                     else "aside")
+        else:
+            state = ("staging" if e["tmp"]
+                     else "committed" if e["committed"] else "TORN")
         print(f"  {e['name']:<28} {state}")
     return 0
 

@@ -30,8 +30,9 @@ from typing import Any, Dict, Optional
 # re-exported here because train code historically imports it from
 # this module.
 from ..util.checkpoint_fs import (COMMIT_MARKER,  # noqa: F401
-                                  atomic_write, is_committed,
-                                  mark_committed, scan_run_dir)
+                                  TMP_SUFFIX, atomic_write,
+                                  is_committed, mark_committed,
+                                  scan_run_dir)
 
 
 @contextmanager
@@ -136,7 +137,11 @@ class CheckpointManager:
     def __init__(self, run_dir: str, num_to_keep: Optional[int] = None,
                  score_attribute: Optional[str] = None,
                  score_order: str = "max"):
-        self.run_dir = run_dir
+        # abspath: entry paths mix copy-path joins and adopted
+        # (already-absolute) dirs — the dedup in register() compares
+        # them as strings, and a relative run_dir would let one
+        # directory get two entries (and _prune rmtree the live one).
+        self.run_dir = os.path.abspath(run_dir)
         self.num_to_keep = num_to_keep
         self.score_attribute = score_attribute
         self.score_order = score_order
@@ -238,5 +243,34 @@ class CheckpointManager:
         for name in cands:
             path = os.path.join(run_dir, name)
             if is_committed(path):
+                return Checkpoint(path)
+        # Legacy fallback: run dirs written BEFORE the commit-marker
+        # discipline carry no marker/manifest anywhere — treating
+        # them all as torn would silently resume a pre-upgrade run
+        # from step 0.  Only when NOTHING in the dir is committed,
+        # accept the newest legacy entry that looks complete (has
+        # payload and no half-written *.tmp files inside).  A dir
+        # with any committed sibling keeps the strict rule: an
+        # uncommitted entry there really is a torn save.  Caveat: a
+        # NEW-format first save killed between its per-file atomic
+        # writes is indistinguishable from a legacy dir here (no
+        # marker, no *.tmp) — so the fallback is logged loudly with
+        # the dir name and restore-time validation stays the
+        # backstop (`rt checkpoint verify` confirms by hand).
+        for name in cands:
+            path = os.path.join(run_dir, name)
+            try:
+                files = os.listdir(path)
+            except OSError:
+                continue
+            if files and not any(f.endswith(TMP_SUFFIX)
+                                 for f in files):
+                import logging
+
+                logging.getLogger("ray_tpu.train").warning(
+                    "no committed checkpoint in %s; resuming from "
+                    "uncommitted legacy dir %s (pre-commit-marker "
+                    "format assumed — run `rt checkpoint verify %s` "
+                    "to confirm it is complete)", run_dir, name, path)
                 return Checkpoint(path)
         return None

@@ -36,6 +36,12 @@ class TrainSession:
     dataset_shards: Dict[str, Any] = field(default_factory=dict)
     storage_dir: str = ""
     telemetry: Optional[TelemetryConfig] = None
+    # Driver-issued per-attempt id, identical across the gang's ranks
+    # and fresh on every (re)start — the sharded-save commit nonce
+    # (save_id = "<step>:<attempt_id>"), so a re-save of a step whose
+    # previous attempt was SIGKILLed mid-save can never commit that
+    # attempt's stale shard indexes.
+    attempt_id: str = ""
     _report_index: int = 0
     _last_report_ts: Optional[float] = None
     _clock: Any = time.monotonic  # injectable for telemetry tests
@@ -199,7 +205,12 @@ class TrainSession:
             path, tree, specs=specs, mesh_axes=mesh_axes,
             process_index=self.world_rank,
             process_count=self.world_size, meta=m,
-            wait_timeout_s=wait_timeout_s)
+            wait_timeout_s=wait_timeout_s,
+            # Per-attempt commit nonce: every rank of this attempt
+            # derives the same value, and a restarted attempt gets a
+            # fresh one — rank 0 refuses a dead attempt's indexes.
+            save_id=(f"{int(step)}:{self.attempt_id}"
+                     if self.attempt_id else None))
         if result["committed"] and report:
             self.report({"step": int(step), **(metrics or {})},
                         checkpoint=Checkpoint(path))

@@ -37,6 +37,7 @@ import json
 import os
 import shutil
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -45,10 +46,11 @@ import numpy as np
 # discipline, verification) lives jax-free in util/checkpoint_fs so
 # the CLI and doctor can use it; re-exported here for API continuity.
 from ..util.checkpoint_fs import (FORMAT_VERSION,  # noqa: F401
-                                  MANIFEST, TMP_SUFFIX,
+                                  MANIFEST, OLD_SUFFIX, TMP_SUFFIX,
                                   CheckpointCorruptError,
                                   CheckpointNotCommittedError,
-                                  crc32_hex, is_sharded_checkpoint,
+                                  covered_elements, crc32_hex,
+                                  is_sharded_checkpoint,
                                   read_manifest, verify_checkpoint)
 
 
@@ -222,13 +224,17 @@ def _spec_map(specs: Any, names: Sequence[str]) -> Dict[str, Any]:
         out = {}
         for name in names:
             node: Any = specs
+            found = True
             for part in name.split("/"):
                 if isinstance(node, dict) and part in node:
                     node = node[part]
                 else:
-                    node = None
+                    found = False
                     break
-            if node is not None:
+            if found:
+                # An explicit falsy value (None/[]/P()) still counts
+                # as PRESENT — it is the deliberate "replicate" spec,
+                # distinct from a leaf the dict never mentions.
                 out[name] = node
         return out
     return dict(_flatten_named(specs))
@@ -264,36 +270,58 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
-def _write_array(path: str, arr: np.ndarray) -> Tuple[str, int]:
-    """np.save + fsync; returns (crc32 hex, byte size).  Serializes
-    through memory so the CRC comes from the same single pass as the
-    write — re-reading every shard just to checksum it would double
-    the save I/O on the preemption-grace-critical path."""
-    import io
+class _CrcFile:
+    """File-like that CRCs every chunk as ``np.save`` streams it
+    through: handed a non-file object, numpy's writer emits bounded
+    (~16 MB) chunks, so the checksum comes from the same single pass
+    as the write with O(chunk) extra memory — neither re-reading the
+    file (doubling save I/O on the preemption-grace-critical path)
+    nor buffering the whole serialization (which tripled peak host
+    memory per shard)."""
 
-    buf = io.BytesIO()
-    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
-    data = buf.getvalue()
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+        self.nbytes = 0
+
+    def write(self, data) -> int:
+        self._f.write(data)
+        self.crc = zlib.crc32(data, self.crc)
+        self.nbytes += len(data)
+        return len(data)
+
+
+def _write_array(path: str, arr: np.ndarray) -> Tuple[str, int]:
+    """np.save + fsync; returns (crc32 hex, byte size)."""
     with open(path, "wb") as f:
-        f.write(data)
+        w = _CrcFile(f)
+        np.save(w, arr, allow_pickle=False)
         f.flush()
         os.fsync(f.fileno())
-    return crc32_hex(data), len(data)
+    return format(w.crc & 0xFFFFFFFF, "08x"), w.nbytes
 
 
 def _read_array(path: str, expect_crc: Optional[str] = None
                 ) -> np.ndarray:
-    with open(path, "rb") as f:
-        data = f.read()
+    """Validated shard read.  The CRC pass streams in bounded chunks
+    and np.load decodes straight from the file (page-cache-warm after
+    the CRC pass) — never the whole serialization AND the decoded
+    array in memory at once (the read-side twin of _CrcFile)."""
     if expect_crc is not None:
-        crc = crc32_hex(data)
-        if crc != expect_crc:
+        crc = 0
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(1 << 24)
+                if not chunk:
+                    break
+                crc = zlib.crc32(chunk, crc)
+        got = format(crc & 0xFFFFFFFF, "08x")
+        if got != expect_crc:
             raise CheckpointCorruptError(
                 f"checksum mismatch for {path}: "
-                f"manifest says {expect_crc}, file is {crc}")
-    import io
-
-    return np.load(io.BytesIO(data), allow_pickle=False)
+                f"manifest says {expect_crc}, file is {got}")
+    with open(path, "rb") as f:
+        return np.load(f, allow_pickle=False)
 
 
 # ===================================================================
@@ -315,7 +343,8 @@ def save_sharded(path: str, tree: Any, *,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
                  meta: Optional[Dict] = None,
-                 wait_timeout_s: float = 120.0) -> Dict[str, Any]:
+                 wait_timeout_s: float = 120.0,
+                 save_id: Optional[str] = None) -> Dict[str, Any]:
     """Write this rank's shards of ``tree`` into ``path + ".tmp"``;
     rank 0 waits for every rank's shard index, writes the manifest
     LAST, and commits with ``os.replace(tmp, path)``.
@@ -330,6 +359,21 @@ def save_sharded(path: str, tree: Any, *,
       describe the layout; the rank writes only the slices of the mesh
       coordinates it owns (replica 0 per leaf).  ``specs=None``
       replicates every leaf (rank 0 writes all of it).
+
+    ``save_id`` is the per-attempt nonce of the two-phase commit:
+    every rank of ONE collective save must pass the same value, and it
+    must differ between attempts at the same ``path`` (the session
+    derives it as ``"<step>:<attempt id>"`` from the driver's
+    per-attempt run id).  Rank 0 commits only shard indexes stamped
+    with the current nonce, so a re-save of a step whose previous
+    attempt was SIGKILLed after some ranks wrote their indexes can
+    never merge that attempt's stale shards into the manifest.
+    Multi-rank callers outside a session should distribute their own
+    nonce; with ``save_id=None`` the stale-index guard degrades to the
+    world-size check (a same-world re-save racing a dead attempt's
+    leftovers is then indistinguishable until each rank rewrites its
+    index).  Single-writer saves (``process_count == 1``) need no
+    nonce — the writer clears the whole stale staging dir first.
 
     Returns ``{"path", "bytes", "files", "committed"}`` for the
     calling rank (``committed`` is True only on the committing rank).
@@ -352,7 +396,8 @@ def save_sharded(path: str, tree: Any, *,
         result = _save_sharded_inner(
             path, tree, specs=specs, mesh_axes=mesh_axes,
             process_index=process_index, process_count=process_count,
-            meta=meta, wait_timeout_s=wait_timeout_s)
+            meta=meta, wait_timeout_s=wait_timeout_s,
+            save_id=save_id)
     _observe_save(result, time.monotonic() - t0)
     return result
 
@@ -377,7 +422,7 @@ def _observe_save(result: Dict[str, Any], dt: float) -> None:
 
 def _save_sharded_inner(path: str, tree: Any, *, specs, mesh_axes,
                         process_index, process_count, meta,
-                        wait_timeout_s) -> Dict[str, Any]:
+                        wait_timeout_s, save_id) -> Dict[str, Any]:
     final = os.path.abspath(path)
     tmp = final + TMP_SUFFIX
     named = _flatten_named(tree)
@@ -404,10 +449,18 @@ def _save_sharded_inner(path: str, tree: Any, *, specs, mesh_axes,
                                 process_count)
 
     shard_dir = os.path.join(tmp, f"shard_{process_index}")
-    # A crashed previous attempt may have left MY stale shard dir in
-    # the shared tmp; replacing only our own keeps ranks from racing
-    # each other's writes.
-    shutil.rmtree(shard_dir, ignore_errors=True)
+    if process_count == 1 and process_index == 0:
+        # Single writer: wipe the WHOLE stale staging dir — a crashed
+        # previous attempt (any world size) can have left complete
+        # shard dirs + indexes there, and nobody else is writing.
+        shutil.rmtree(tmp, ignore_errors=True)
+    else:
+        # A crashed previous attempt may have left MY stale shard dir
+        # in the shared tmp; replacing only our own keeps ranks from
+        # racing each other's writes.  Stale PEER shard dirs are
+        # handled at commit: rank 0 only accepts indexes stamped with
+        # the current save_id/world (see _commit).
+        shutil.rmtree(shard_dir, ignore_errors=True)
     os.makedirs(shard_dir, exist_ok=True)
 
     entries: List[Dict[str, Any]] = []
@@ -427,9 +480,28 @@ def _save_sharded_inner(path: str, tree: Any, *, specs, mesh_axes,
                       if s.replica_id == 0]
         else:
             arr = np.asarray(leaf)
-            # () == replicate: jax-free default so non-jax workers
-            # never import jax.sharding just to say "unsharded".
-            spec = spec_by_name.get(name) or ()
+            if name in spec_by_name:
+                spec = spec_by_name[name] or ()
+            elif isinstance(specs, dict) and arr.ndim:
+                # A leaf silently absent from an EXPLICIT specs dict
+                # (typo'd key, renamed param) would fall back to
+                # replicated — i.e. a rank-0 full write, the exact
+                # gather this plane exists to avoid.  Require an
+                # explicit []/None to replicate.  (Dict specs only:
+                # non-dict pytree mirrors drop None/empty markers
+                # during flattening, so absence there is the normal
+                # replicate convention; specs=None keeps the
+                # replicate-everything default; scalars always
+                # replicate.)
+                raise ValueError(
+                    f"leaf {name!r} has no entry in the given specs "
+                    f"dict — pass an explicit [] (replicate) or a "
+                    f"partition spec for every non-scalar host leaf")
+            else:
+                # () == replicate: jax-free default so non-jax
+                # workers never import jax.sharding just to say
+                # "unsharded".
+                spec = ()
             for axes in _spec_entries(spec, arr.ndim):
                 for a in axes:
                     if a not in mesh_axes:
@@ -476,6 +548,8 @@ def _save_sharded_inner(path: str, tree: Any, *, specs, mesh_axes,
 
     atomic_write(os.path.join(shard_dir, "index.json"),
                  json.dumps({"rank": process_index,
+                             "world": process_count,
+                             "save_id": save_id,
                              "entries": entries,
                              "leaves": leaf_meta}))
     _fsync_dir(shard_dir)
@@ -483,40 +557,102 @@ def _save_sharded_inner(path: str, tree: Any, *, specs, mesh_axes,
     committed = False
     if process_index == 0:
         _commit(tmp, final, mesh_axes, process_count, meta,
-                wait_timeout_s)
+                wait_timeout_s, save_id)
         committed = True
     return {"path": final, "bytes": total_bytes, "files": counter,
             "committed": committed}
 
 
+def _read_index(path: str) -> Optional[Dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None  # mid-replace / vanished: treat as not yet there
+
+
+def _index_stale(idx: Optional[Dict], world: int,
+                 save_id: Optional[str]) -> Optional[str]:
+    """Why this shard index cannot belong to the CURRENT save attempt
+    (None if it can).  The guard against committing a manifest that
+    mixes a SIGKILLed previous attempt's complete-looking indexes with
+    the current attempt's shards — their CRCs self-validate, so
+    nothing downstream would catch it."""
+    if idx is None:
+        return "missing"
+    if idx.get("world") != world:
+        return (f"stale (written at world {idx.get('world')}, "
+                f"this save is world {world})")
+    if save_id is not None and idx.get("save_id") != save_id:
+        return (f"stale (save_id {idx.get('save_id')!r}, this save "
+                f"is {save_id!r})")
+    return None
+
+
 def _commit(tmp: str, final: str, mesh_axes: Dict[str, int],
             world: int, meta: Optional[Dict],
-            wait_timeout_s: float) -> None:
+            wait_timeout_s: float,
+            save_id: Optional[str] = None) -> None:
     """Rank 0's half of the two-phase commit: wait for every rank's
-    shard index, merge them into the manifest, fsync, rename."""
+    shard index TO CARRY THE CURRENT ATTEMPT'S STAMP (save_id +
+    world — mere existence is not enough: a previous SIGKILLed
+    attempt of the same step leaves complete stale indexes in the
+    shared staging dir until each rank's re-save replaces its own),
+    merge them into the manifest, fsync, rename."""
     deadline = time.monotonic() + wait_timeout_s
     index_paths = [os.path.join(tmp, f"shard_{r}", "index.json")
                    for r in range(world)]
+    # An index that validated once cannot turn stale (its rank will
+    # not rewrite it within the attempt) — cache acceptances so each
+    # poll tick re-reads only the still-pending ranks, not all of
+    # them (matters at large world on shared storage).
+    accepted: Dict[str, Dict] = {}
     while True:
-        missing = [p for p in index_paths if not os.path.exists(p)]
-        if not missing:
+        pending = {}
+        for p in index_paths:
+            if p in accepted:
+                continue
+            idx = _read_index(p)
+            why = _index_stale(idx, world, save_id)
+            if why is not None:
+                pending[p] = why
+            else:
+                accepted[p] = idx
+        if not pending:
             break
         if time.monotonic() > deadline:
+            detail = "; ".join(
+                f"{os.path.basename(os.path.dirname(p))}: {why}"
+                for p, why in pending.items())
             raise TimeoutError(
-                f"sharded save: rank(s) "
-                f"{[os.path.dirname(p)[-8:] for p in missing]} never "
-                f"wrote their shard index within {wait_timeout_s}s; "
-                f"NOT committing {final}")
+                f"sharded save: shard index(es) not written by their "
+                f"rank for this attempt within {wait_timeout_s}s "
+                f"({detail}); NOT committing {final}")
         time.sleep(0.05)
 
     files: List[Dict] = []
     leaves: Dict[str, Dict] = {}
     for p in index_paths:
-        with open(p) as f:
-            idx = json.load(f)
+        idx = accepted[p]
         files.extend(idx.get("entries", []))
         for name, m in (idx.get("leaves") or {}).items():
             leaves.setdefault(name, m)
+    # Leftover shard dirs beyond this save's world (an elastic shrink
+    # re-saving over a bigger dead attempt) are not in the manifest —
+    # drop them so they don't ride into the committed dir as garbage.
+    try:
+        for name in os.listdir(tmp):
+            if not name.startswith("shard_"):
+                continue
+            try:
+                rank = int(name[len("shard_"):])
+            except ValueError:
+                continue
+            if rank >= world:
+                shutil.rmtree(os.path.join(tmp, name),
+                              ignore_errors=True)
+    except OSError:
+        pass
     manifest = {
         "version": FORMAT_VERSION,
         "world_size": world,
@@ -532,13 +668,18 @@ def _commit(tmp: str, final: str, mesh_axes: Dict[str, int],
     _fsync_dir(tmp)
     if os.path.isdir(final):
         # A committed checkpoint already holds this name (a re-save of
-        # the same step after a restart): replace it atomically by
-        # renaming it aside first — never delete the only good copy
-        # before the new one is committed.  The aside name keeps the
+        # the same step after a restart): swap by renaming it aside,
+        # then renaming the new copy in.  The aside name keeps the
         # .tmp suffix so a crash mid-swap leaves a directory every
         # reader (is_committed/find_latest_in/scan_run_dir) already
         # ignores, not a stale twin that outsorts the real one.
-        old = final + ".old" + TMP_SUFFIX
+        # Known window: a crash BETWEEN the two os.replace calls
+        # leaves no committed copy under this name — resume falls back
+        # to an older committed checkpoint (never corruption), and the
+        # good copy survives at the aside name, which scan_run_dir
+        # marks ``recoverable`` and ``rt doctor`` tells the operator
+        # to rename back.
+        old = final + OLD_SUFFIX
         shutil.rmtree(old, ignore_errors=True)
         os.replace(final, old)
         os.replace(tmp, final)
@@ -558,7 +699,7 @@ def _assemble(shape, dtype, ranges, file_entries, base_dir,
     """Fill a [ranges]-shaped array from the intersections the saved
     files contribute — the reshard read path."""
     out = np.empty([hi - lo for lo, hi in ranges], dtype=dtype)
-    filled = 0
+    inters = []
     for ent in file_entries:
         src_ranges = tuple(tuple(r) for r in ent["index"])
         inter = intersect(ranges, src_ranges)
@@ -578,9 +719,14 @@ def _assemble(shape, dtype, ranges, file_entries, base_dir,
         src = tuple(slice(lo - r[0], hi - r[0])
                     for (lo, hi), r in zip(inter, src_ranges))
         out[dst] = arr[src]
-        filled += int(np.prod([hi - lo for lo, hi in inter]))
+        inters.append(inter)
     want = int(np.prod([hi - lo for lo, hi in ranges])) if ranges \
         else 1
+    # UNION coverage (interval arithmetic), never summed volumes:
+    # overlapping saved slices occur exactly in the malformed-manifest
+    # cases this backstop exists for, and a sum would let them mask an
+    # np.empty-garbage hole.
+    filled = covered_elements(ranges, inters)
     if filled < want:
         raise CheckpointCorruptError(
             f"saved shards cover only {filled}/{want} elements of "
@@ -611,20 +757,12 @@ def load_sharded(path: str, *, mesh=None, specs: Any = None,
     """
     from ..util import goodput
 
-    t0 = time.monotonic()
-    with goodput.ledger().phase("checkpoint"):
-        out = _load_sharded_inner(path, mesh=mesh, specs=specs,
-                                  target=target, validate=validate)
-    try:
-        from ..util.metrics import Histogram
-
-        Histogram("rt_train_checkpoint_restore_seconds",
-                  "Checkpoint payload save/restore duration.",
-                  tag_keys=("sharded",)).observe(
-            time.monotonic() - t0, tags={"sharded": "1"})
-    except Exception:
-        pass
-    return out
+    with goodput.timed_phase(
+            "checkpoint", "rt_train_checkpoint_restore_seconds",
+            "Checkpoint payload save/restore duration.",
+            tags={"sharded": "1"}, tag_keys=("sharded",)):
+        return _load_sharded_inner(path, mesh=mesh, specs=specs,
+                                   target=target, validate=validate)
 
 
 def _load_sharded_inner(path, *, mesh, specs, target, validate):
@@ -662,7 +800,6 @@ def _load_sharded_inner(path, *, mesh, specs, target, validate):
         imap = sharding.devices_indices_map(shape)
         pieces: Dict[Tuple, np.ndarray] = {}
         arrays = []
-        devices = []
         for dev, index in imap.items():
             if dev.process_index != jax.process_index():
                 continue
@@ -672,7 +809,6 @@ def _load_sharded_inner(path, *, mesh, specs, target, validate):
                 piece = _assemble(shape, dtype, ranges, entries,
                                   path, validate, cache)
                 pieces[ranges] = piece
-            devices.append(dev)
             arrays.append(jax.device_put(piece, dev))
         restored[name] = jax.make_array_from_single_device_arrays(
             shape, sharding, arrays)

@@ -139,7 +139,8 @@ class BaseTrainer:
                     self.run_config.name or "train_run",
                     self.run_config.telemetry,
                     os.environ.get("RT_JOB_ID", ""),
-                    self.run_config.resolved_storage_path()))
+                    self.run_config.resolved_storage_path(),
+                    run_id))
             final_metrics: Dict = {}
             pending = list(refs)
             self._drain_notice = None
@@ -342,7 +343,7 @@ class BaseTrainer:
 
 def _worker_entry(train_loop, config, rank, world, local_info, queue,
                   ckpt_path, shards, experiment_name, telemetry=None,
-                  job_id="", storage_dir=""):
+                  job_id="", storage_dir="", run_id=""):
     """Runs inside the worker actor: set up the session, run user code."""
     from . import session as session_mod
     from .checkpoint import Checkpoint
@@ -364,7 +365,10 @@ def _worker_entry(train_loop, config, rank, world, local_info, queue,
         checkpoint=Checkpoint(ckpt_path) if ckpt_path else None,
         dataset_shards=shards,
         storage_dir=storage_dir,
-        telemetry=telemetry)
+        telemetry=telemetry,
+        # The attempt's run_id doubles as the sharded-save commit
+        # nonce: identical across ranks, fresh on every restart.
+        attempt_id=run_id)
     from ..util import flight_recorder
 
     flight_recorder.record("train_worker_start", rank=rank,

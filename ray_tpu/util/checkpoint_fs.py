@@ -25,6 +25,13 @@ from typing import Any, Dict, List, Optional
 MANIFEST = "manifest.json"
 COMMIT_MARKER = ".rt_committed"
 TMP_SUFFIX = ".tmp"
+# A re-save of an already-committed name renames the old copy aside
+# under this suffix for the instant of the swap (see _commit in
+# train/sharded_checkpoint.py).  It still ends in TMP_SUFFIX so every
+# reader ignores it, but the scan/doctor distinguish it: if a crash
+# hit the swap window, the aside copy is the only good one and an
+# operator can rename it back.
+OLD_SUFFIX = ".old" + TMP_SUFFIX
 FORMAT_VERSION = 1
 
 
@@ -40,6 +47,55 @@ class CheckpointNotCommittedError(RuntimeError):
 
 def crc32_hex(data: bytes) -> str:
     return format(zlib.crc32(data) & 0xFFFFFFFF, "08x")
+
+
+def covered_elements(target, boxes) -> int:
+    """Exact number of elements of the per-dim ``[lo, hi)`` box
+    ``target`` covered by the UNION of ``boxes`` — interval arithmetic
+    via coordinate compression, so overlapping boxes never double
+    count.  This is the restore-time completeness backstop: a summed
+    per-box volume can mask an uncovered hole exactly in the
+    malformed-manifest cases (mixed save attempts, duplicated slices)
+    where overlaps occur."""
+    import bisect
+    import itertools
+
+    ndim = len(target)
+    clipped = []
+    for box in boxes:
+        if len(box) != ndim:
+            continue
+        c = []
+        for (lo, hi), (tlo, thi) in zip(box, target):
+            lo, hi = max(int(lo), int(tlo)), min(int(hi), int(thi))
+            if lo >= hi:
+                break
+            c.append((lo, hi))
+        else:
+            clipped.append(tuple(c))
+    if ndim == 0:
+        return 1 if clipped else 0
+    if not clipped:
+        return 0
+    edges = []
+    for d in range(ndim):
+        es = {int(target[d][0]), int(target[d][1])}
+        for c in clipped:
+            es.update(c[d])
+        edges.append(sorted(es))
+    cells = set()
+    for c in clipped:
+        cells.update(itertools.product(*(
+            range(bisect.bisect_left(edges[d], c[d][0]),
+                  bisect.bisect_left(edges[d], c[d][1]))
+            for d in range(ndim))))
+    total = 0
+    for cell in cells:
+        vol = 1
+        for d, i in enumerate(cell):
+            vol *= edges[d][i + 1] - edges[d][i]
+        total += vol
+    return total
 
 
 def atomic_write(path: str, data) -> None:
@@ -91,9 +147,17 @@ def read_manifest(path: str) -> Dict[str, Any]:
 
 def scan_run_dir(run_dir: str) -> List[Dict[str, Any]]:
     """Inventory every checkpoint_* entry in a run directory —
-    committed, torn (dir present but never committed), or staging
-    (*.tmp) — for ``rt doctor``'s checkpoint-risk finding and the
-    torn-write chaos tooling."""
+    committed, torn (dir present but never committed), staging
+    (*.tmp), or an aside copy from a re-save swap (*.old.tmp) — for
+    ``rt doctor``'s checkpoint-risk finding and the torn-write chaos
+    tooling.
+
+    ``*.old.tmp`` entries additionally carry ``recoverable`` (the
+    aside copy's CONTENT is a committed checkpoint: manifest or commit
+    marker present) and ``final`` (the name it was renamed aside
+    from).  When ``recoverable`` is set and ``final`` is absent, a
+    crash hit the re-save swap window and the aside copy is the only
+    good copy of that step — rename it back to recover."""
     out: List[Dict[str, Any]] = []
     if not os.path.isdir(run_dir):
         return out
@@ -109,10 +173,33 @@ def scan_run_dir(run_dir: str) -> List[Dict[str, Any]]:
             mtime = os.path.getmtime(path)
         except OSError:
             mtime = 0.0
-        out.append({"name": name, "path": path, "tmp": tmp,
-                    "committed": committed,
-                    "torn": not tmp and not committed,
-                    "mtime": mtime})
+        # A live multi-rank save touches only shard_*/ subdirs after
+        # creating them — the parent staging dir's mtime freezes at
+        # creation.  Take the freshest so an in-flight save longer
+        # than the stale-staging window is not misreported as
+        # abandoned (whose probe suggests deleting it mid-save).
+        # Staging entries only: committed dirs feed no age check, and
+        # statting every shard of every committed checkpoint would
+        # tax shared filesystems on each doctor poll.
+        if tmp:
+            try:
+                for sub in os.listdir(path):
+                    sp = os.path.join(path, sub)
+                    if os.path.isdir(sp):
+                        mtime = max(mtime, os.path.getmtime(sp))
+            except OSError:
+                pass
+        entry = {"name": name, "path": path, "tmp": tmp,
+                 "committed": committed,
+                 "torn": not tmp and not committed,
+                 "old": name.endswith(OLD_SUFFIX),
+                 "mtime": mtime}
+        if entry["old"]:
+            entry["final"] = name[:-len(OLD_SUFFIX)]
+            entry["recoverable"] = (
+                os.path.isfile(os.path.join(path, MANIFEST))
+                or os.path.isfile(os.path.join(path, COMMIT_MARKER)))
+        out.append(entry)
     return out
 
 
@@ -130,7 +217,13 @@ def verify_checkpoint(path: str) -> Dict[str, Any]:
     if not os.path.isdir(path):
         report["errors"].append("not a directory")
         return report
-    if path.endswith(TMP_SUFFIX):
+    if path.endswith(OLD_SUFFIX):
+        # Aside copy from a re-save swap: readers ignore it, but the
+        # doctor's recoverable-checkpoint probe sends the operator
+        # HERE to decide whether to rename it back — verify its
+        # CONTENT instead of short-circuiting on the .tmp suffix.
+        report["aside"] = True
+    elif path.endswith(TMP_SUFFIX):
         report["errors"].append(
             "uncommitted staging directory (*.tmp) — a save was "
             "interrupted before its commit rename")
@@ -156,7 +249,7 @@ def verify_checkpoint(path: str) -> Dict[str, Any]:
     report["world_size"] = manifest.get("world_size")
     report["mesh"] = (manifest.get("mesh") or {}).get("shape")
     report["leaves"] = len(manifest.get("leaves") or {})
-    covered: Dict[str, int] = {}
+    boxes: Dict[str, List] = {}
     for ent in manifest.get("files", []):
         report["files"] += 1
         fpath = os.path.join(path, ent["file"])
@@ -165,27 +258,38 @@ def verify_checkpoint(path: str) -> Dict[str, Any]:
                                     f"{ent['file']}")
             continue
         try:
+            # Chunked CRC: shard files can be multi-GB; never hold a
+            # full serialization in memory just to checksum it.
+            crc_acc, nbytes = 0, 0
             with open(fpath, "rb") as f:
-                data = f.read()
+                while True:
+                    chunk = f.read(1 << 24)
+                    if not chunk:
+                        break
+                    crc_acc = zlib.crc32(chunk, crc_acc)
+                    nbytes += len(chunk)
         except OSError as e:
             report["errors"].append(f"unreadable {ent['file']}: {e}")
             continue
-        report["bytes"] += len(data)
-        crc = crc32_hex(data)
+        report["bytes"] += nbytes
+        crc = format(crc_acc & 0xFFFFFFFF, "08x")
         if crc != ent.get("crc32"):
             report["errors"].append(
                 f"checksum mismatch in {ent['file']} "
                 f"(manifest {ent.get('crc32')}, file {crc})")
-        n = 1
-        for lo, hi in ent.get("index", []):
-            n *= max(hi - lo, 0)
-        covered[ent["leaf"]] = covered.get(ent["leaf"], 0) + n
+        boxes.setdefault(ent["leaf"], []).append(
+            tuple(tuple(r) for r in ent.get("index", [])))
     for name, info in (manifest.get("leaves") or {}).items():
-        want = max(math.prod(info.get("shape") or []), 1)
-        # Replicated slices over-cover; under-coverage is the error.
-        if covered.get(name, 0) < want:
+        shape = info.get("shape") or []
+        want = max(math.prod(shape), 1)
+        # Union coverage, not summed volumes: replicated/overlapping
+        # slices must not mask an uncovered hole (the exact
+        # malformed-manifest case this backstop exists for).
+        got = covered_elements(tuple((0, d) for d in shape),
+                               boxes.get(name, []))
+        if got < want:
             report["errors"].append(
                 f"leaf {name!r}: saved slices cover "
-                f"{covered.get(name, 0)}/{want} elements")
+                f"{got}/{want} elements")
     report["ok"] = not report["errors"]
     return report

@@ -40,6 +40,7 @@ controller.  Thresholds come from the standard flag table
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -615,6 +616,11 @@ def find_checkpoint_risk(scans: List[Dict],
       operator should reap and a signal saves are being interrupted.
       Abandoned ``*.tmp`` staging dirs older than ``stale_tmp_s``
       count too.
+    - **recoverable aside copies** — a ``*.old.tmp`` dir whose
+      content is a committed checkpoint while its final name is
+      absent: a re-save swap crashed between its two renames, and the
+      aside copy is the only good copy of that step — the operator
+      should rename it back.
     - **save slower than the grace window** — the cluster's observed
       checkpoint-save p99 exceeding ``RT_PREEMPTION_GRACE_S`` is
       CRITICAL: a checkpoint-on-notice raced against a preemption
@@ -628,7 +634,47 @@ def find_checkpoint_risk(scans: List[Dict],
     out = []
     for scan in scans or []:
         run_dir = scan.get("run_dir", "?")
-        for ent in scan.get("entries", []):
+        entries = scan.get("entries", [])
+        committed_names = {e.get("name") for e in entries
+                           if e.get("committed")}
+        for ent in entries:
+            if ent.get("old"):
+                # Aside copy from a re-save swap (*.old.tmp).  If the
+                # final name never came back, the crash hit the swap
+                # window between the two renames and this aside copy
+                # is the ONLY good copy of that step — recoverable by
+                # renaming it back (restore meanwhile falls back to an
+                # older committed checkpoint, so no corruption).
+                final = ent.get("final", "")
+                if ent.get("recoverable") and \
+                        final not in committed_names:
+                    fpath = os.path.join(run_dir, final) \
+                        if run_dir != "?" else final
+                    out.append(_finding(
+                        "recoverable_checkpoint", "warning",
+                        f"interrupted re-save swap left the only "
+                        f"good copy of {final} at {ent.get('name')} "
+                        f"in {run_dir}",
+                        detail="a re-save of an already-committed "
+                               "step crashed between renaming the "
+                               "old copy aside and committing the "
+                               "new one; the aside directory holds "
+                               "the previous committed content — "
+                               "rename it back to recover that "
+                               "step (resume otherwise falls back "
+                               "to an older checkpoint).",
+                        probe=f"rt checkpoint verify "
+                              f"{ent.get('path')}; then "
+                              f"mv {ent.get('path')} {fpath}",
+                        data={"run_dir": run_dir,
+                              "final": final,
+                              **{k: ent.get(k) for k in
+                                 ("name", "path", "recoverable",
+                                  "mtime")}}))
+                    continue
+                # Final committed again (or aside content torn):
+                # plain leftover debris — fall through to the stale-
+                # staging age check below.
             stale_tmp = ent.get("tmp") and \
                 now - ent.get("mtime", now) > stale_tmp_s
             if not ent.get("torn") and not stale_tmp:
@@ -781,29 +827,35 @@ def _checkpoint_save_stats(sources: Dict[str, List[Dict]]
                            ) -> Optional[Dict[str, Any]]:
     """Merge the cluster's ``rt_train_checkpoint_save_seconds``
     histograms (every source, every ``sharded`` tag) into one
-    {count, p99} — the grace-window check's input."""
+    {count, p99} — the grace-window check's input.  Bucket counts are
+    summed only WITHIN a bucket-boundary layout; if sources ever
+    report different boundaries, each group gets its own quantile and
+    the worst (largest) p99 is reported — summing counts against
+    mismatched boundaries would skew the p99-vs-grace check."""
     from .telemetry import _hist_quantile
 
-    count = 0
-    buckets: List[int] = []
-    boundaries: List[float] = []
+    # boundaries tuple -> [count, bucket counts]
+    groups: Dict[tuple, List[Any]] = {}
     for snaps in (sources or {}).values():
         for snap in snaps:
             if snap.get("name") != "rt_train_checkpoint_save_seconds":
                 continue
-            boundaries = snap.get("boundaries") or boundaries
+            key = tuple(snap.get("boundaries") or ())
+            g = groups.setdefault(key, [0, []])
             for s in snap.get("series", []):
                 h = s.get("hist") or {}
-                count += int(h.get("count", 0))
+                g[0] += int(h.get("count", 0))
                 bk = h.get("buckets") or []
-                if len(buckets) < len(bk):
-                    buckets += [0] * (len(bk) - len(buckets))
+                if len(g[1]) < len(bk):
+                    g[1] += [0] * (len(bk) - len(g[1]))
                 for i, c in enumerate(bk):
-                    buckets[i] += c
-    if not count:
+                    g[1][i] += c
+    total = sum(g[0] for g in groups.values())
+    if not total:
         return None
-    return {"count": count,
-            "p99": _hist_quantile(boundaries, buckets, count, 0.99)}
+    p99 = max(_hist_quantile(list(key), g[1], g[0], 0.99)
+              for key, g in groups.items() if g[0])
+    return {"count": total, "p99": p99}
 
 
 def cluster_diagnosis(*, address: Optional[str] = None,

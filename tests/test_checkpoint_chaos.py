@@ -305,9 +305,16 @@ def test_torn_write_never_corrupts_resume(cluster, tmp_path):
     assert np.array_equal(restored["w"], tree["w"])
     assert np.array_equal(restored["b"], tree["b"])
 
-    # Backdate the staging dir past the in-flight window: rt doctor
-    # (against the live cluster, with the run-dir scan) names it.
-    os.utime(staging, (time.time() - 600, time.time() - 600))
+    # Backdate the staging dir (and its shard subdirs — a LIVE save
+    # keeps those fresh, and the scan honors the freshest) past the
+    # in-flight window: rt doctor (against the live cluster, with the
+    # run-dir scan) names it.
+    past = (time.time() - 600, time.time() - 600)
+    os.utime(staging, past)
+    for sub in os.listdir(staging):
+        sp = os.path.join(staging, sub)
+        if os.path.isdir(sp):
+            os.utime(sp, past)
     entries = scan_run_dir(run)
     assert any(e["tmp"] for e in entries), entries
     d = _rt("doctor", "--format", "json", "--run-dir", run,

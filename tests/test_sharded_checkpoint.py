@@ -326,6 +326,100 @@ def test_resave_same_step_leaves_single_committed_dir(tmp_path):
     assert os.path.isdir(path)
 
 
+def test_commit_rejects_stale_indexes_from_dead_attempt(tmp_path):
+    """The crash-then-resave race: attempt A (world 2) is SIGKILLed
+    after rank 1 wrote its shard index but before rank 0 committed.
+    On the re-save, rank 0 must NOT satisfy its commit wait with the
+    stale (CRC-valid!) index — only indexes stamped with the current
+    save_id commit."""
+    from ray_tpu.train.sharded_checkpoint import (load_sharded,
+                                                  save_sharded)
+
+    path = str(tmp_path / "checkpoint_000005")
+    tree_a = {"w": np.zeros((8, 4), np.float32)}
+    tree_b = {"w": np.ones((8, 4), np.float32)}
+    specs = {"w": ["fsdp"]}
+
+    # Attempt A: rank 1 stages its shards + index, then "dies"
+    # (rank 0 never runs, so nothing commits).
+    save_sharded(path, tree_a, specs=specs, mesh_axes={"fsdp": 2},
+                 process_index=1, process_count=2, save_id="5:a")
+    assert os.path.isfile(os.path.join(
+        path + ".tmp", "shard_1", "index.json"))
+
+    # Attempt B rank 0 arrives first: the stale shard_1 index must
+    # not be committed — the wait times out instead.
+    with pytest.raises(TimeoutError, match="save_id"):
+        save_sharded(path, tree_b, specs=specs,
+                     mesh_axes={"fsdp": 2}, process_index=0,
+                     process_count=2, save_id="5:b",
+                     wait_timeout_s=0.4)
+    assert not os.path.isdir(path)  # nothing committed
+
+    # Once attempt B's rank 1 has actually written, rank 0 commits —
+    # and the result is ALL attempt-B data.
+    save_sharded(path, tree_b, specs=specs, mesh_axes={"fsdp": 2},
+                 process_index=1, process_count=2, save_id="5:b")
+    save_sharded(path, tree_b, specs=specs, mesh_axes={"fsdp": 2},
+                 process_index=0, process_count=2, save_id="5:b")
+    assert np.array_equal(load_sharded(path)["w"], tree_b["w"])
+
+
+def test_commit_rejects_stale_world_size_indexes(tmp_path):
+    """Elastic shrink over a dead attempt's debris: indexes written at
+    a different world size never merge (even with no save_id), and
+    leftover shard_N dirs beyond the new world are pruned from the
+    committed directory."""
+    from ray_tpu.train.sharded_checkpoint import (load_sharded,
+                                                  save_sharded)
+
+    path = str(tmp_path / "checkpoint_000006")
+    tree_a = {"w": np.zeros((8, 4), np.float32)}
+    tree_b = {"w": np.full((8, 4), 2.0, np.float32)}
+    specs = {"w": ["fsdp"]}
+
+    # Dead attempt at world 4: ranks 1-3 staged, rank 0 never commits.
+    for r in (1, 2, 3):
+        save_sharded(path, tree_a, specs=specs, mesh_axes={"fsdp": 4},
+                     process_index=r, process_count=4)
+
+    # Re-save at world 2: rank 0 must reject shard_1's world-4 index.
+    with pytest.raises(TimeoutError, match="world"):
+        save_sharded(path, tree_b, specs=specs,
+                     mesh_axes={"fsdp": 2}, process_index=0,
+                     process_count=2, wait_timeout_s=0.4)
+    assert not os.path.isdir(path)
+
+    save_sharded(path, tree_b, specs=specs, mesh_axes={"fsdp": 2},
+                 process_index=1, process_count=2)
+    save_sharded(path, tree_b, specs=specs, mesh_axes={"fsdp": 2},
+                 process_index=0, process_count=2)
+    assert np.array_equal(load_sharded(path)["w"], tree_b["w"])
+    # shard_2/shard_3 debris from the dead world-4 attempt is gone.
+    shards = sorted(d for d in os.listdir(path)
+                    if d.startswith("shard_"))
+    assert shards == ["shard_0", "shard_1"]
+
+
+def test_single_writer_resave_wipes_stale_staging(tmp_path):
+    """process_count == 1 clears the WHOLE stale staging dir before
+    writing — a dead multi-rank attempt's shard dirs can't leak into
+    the committed single-writer checkpoint."""
+    from ray_tpu.train.sharded_checkpoint import (load_sharded,
+                                                  save_sharded)
+
+    path = str(tmp_path / "checkpoint_000007")
+    stale = {"w": np.zeros((8, 4), np.float32)}
+    save_sharded(path, stale, specs={"w": ["fsdp"]},
+                 mesh_axes={"fsdp": 2}, process_index=1,
+                 process_count=2, save_id="7:dead")
+    fresh = {"w": np.full((8, 4), 3.0, np.float32)}
+    save_sharded(path, fresh)
+    assert np.array_equal(load_sharded(path)["w"], fresh["w"])
+    assert sorted(d for d in os.listdir(path)
+                  if d.startswith("shard_")) == ["shard_0"]
+
+
 def test_host_save_rejects_unknown_spec_axis(tmp_path):
     """A spec naming a mesh axis absent from mesh_axes must raise —
     silently treating it as size 1 would collapse to rank-0 writing
@@ -337,6 +431,80 @@ def test_host_save_rejects_unknown_spec_axis(tmp_path):
                      {"w": np.ones((4, 4), np.float32)},
                      specs={"w": ["fsdp"]},
                      mesh_axes={"data": 2}, process_count=2)
+
+
+def test_explicit_specs_must_cover_every_host_leaf(tmp_path):
+    """A leaf silently missing from an explicitly-passed specs dict
+    (typo'd key) must raise — falling back to replicated would be a
+    silent rank-0 full write.  Explicit [] (or None) still means
+    replicate, and specs=None keeps the replicate-all default."""
+    from ray_tpu.train.sharded_checkpoint import save_sharded
+
+    tree = {"w": np.ones((4, 4), np.float32),
+            "b": np.ones((4,), np.float32)}
+    path = str(tmp_path / "checkpoint_000001")
+    with pytest.raises(ValueError, match="'b'"):
+        save_sharded(path, tree, specs={"w": ["fsdp"], "B": []},
+                     mesh_axes={"fsdp": 2}, process_index=0,
+                     process_count=2, save_id="x",
+                     wait_timeout_s=0.1)
+    # Explicit replicate markers and the no-specs default still work.
+    save_sharded(path, tree, specs={"w": ["fsdp"], "b": None},
+                 mesh_axes={"fsdp": 1})
+    save_sharded(str(tmp_path / "checkpoint_000002"), tree)
+
+
+def test_scan_live_staging_uses_shard_subdir_mtime(tmp_path):
+    """A long-running multi-rank save only touches shard_*/ subdirs;
+    the stale-staging check must see those mtimes, not the frozen
+    parent dir mtime — or doctor tells the operator to rm an
+    in-flight save."""
+    from ray_tpu.util.checkpoint_fs import scan_run_dir
+    from ray_tpu.util.doctor import find_checkpoint_risk
+
+    run = str(tmp_path / "run")
+    staging = os.path.join(run, "checkpoint_000001.tmp")
+    shard = os.path.join(staging, "shard_0")
+    os.makedirs(shard)
+    past = time.time() - 600
+    os.utime(staging, (past, past))  # parent froze at creation
+    # shard_0 is fresh (a rank is actively writing).
+    entries = scan_run_dir(run)
+    assert not find_checkpoint_risk(
+        [{"run_dir": run, "entries": entries}], None, 30.0,
+        now=time.time())
+    # Once the shards go stale too, the abandoned finding fires.
+    os.utime(shard, (past, past))
+    os.utime(staging, (past, past))
+    entries = scan_run_dir(run)
+    out = find_checkpoint_risk(
+        [{"run_dir": run, "entries": entries}], None, 30.0,
+        now=time.time())
+    assert [f["check"] for f in out] == ["torn_checkpoint"]
+
+
+def test_find_latest_legacy_dirs_without_markers(tmp_path):
+    """Pre-commit-discipline run dirs (no marker/manifest anywhere)
+    must still resume — from the newest complete-looking legacy dir —
+    while a dir with ANY committed entry keeps the strict torn
+    skip."""
+    from ray_tpu.train.checkpoint import CheckpointManager
+
+    run = str(tmp_path / "legacy")
+    for i in (1, 2):
+        d = os.path.join(run, f"checkpoint_{i:06d}")
+        os.makedirs(d)
+        open(os.path.join(d, "model.msgpack"), "wb").write(b"x")
+    latest = CheckpointManager.find_latest_in(run)
+    assert latest is not None
+    assert os.path.basename(latest.path) == "checkpoint_000002"
+
+    # A half-written legacy dir (stray *.tmp inside) is not trusted.
+    torn = os.path.join(run, "checkpoint_000003")
+    os.makedirs(torn)
+    open(os.path.join(torn, "model.msgpack.tmp"), "wb").write(b"x")
+    latest = CheckpointManager.find_latest_in(run)
+    assert os.path.basename(latest.path) == "checkpoint_000002"
 
 
 def test_manifest_checksum_rejection(tmp_path):
@@ -539,6 +707,112 @@ def test_doctor_checkpoint_risk_findings(tmp_path):
                                     30.0, now=now)
 
 
+def test_covered_elements_union_not_sum():
+    from ray_tpu.util.checkpoint_fs import covered_elements
+
+    t = ((0, 4), (0, 4))
+    # Two overlapping halves cover everything exactly once.
+    assert covered_elements(t, [((0, 3), (0, 4)),
+                               ((1, 4), (0, 4))]) == 16
+    # Duplicated slice: summed volumes would say 16; the union says 8.
+    assert covered_elements(t, [((0, 2), (0, 4)),
+                               ((0, 2), (0, 4))]) == 8
+    # Boxes are clipped to the target.
+    assert covered_elements(((1, 3),), [((0, 10),)]) == 2
+    assert covered_elements(((0, 4),), []) == 0
+    # Scalars: any box covers, none doesn't.
+    assert covered_elements((), [()]) == 1
+    assert covered_elements((), []) == 0
+
+
+def test_overlapping_slices_never_mask_a_gap(tmp_path):
+    """The malformed-manifest backstop: duplicate a slice entry so
+    summed volumes equal the leaf size while half the leaf is a hole —
+    restore and verify must both flag under-coverage."""
+    from ray_tpu.train.sharded_checkpoint import (
+        CheckpointCorruptError, load_sharded, save_sharded)
+    from ray_tpu.util.checkpoint_fs import verify_checkpoint
+
+    path = str(tmp_path / "checkpoint_000008")
+    save_sharded(path, {"w": np.arange(8, dtype=np.float32)},
+                 specs={"w": ["fsdp"]}, mesh_axes={"fsdp": 2})
+    mpath = os.path.join(path, "manifest.json")
+    manifest = json.load(open(mpath))
+    ents = [e for e in manifest["files"] if e["leaf"] == "w"]
+    assert [e["index"] for e in ents] == [[[0, 4]], [[4, 8]]]
+    # Point the second entry at the first file/slice: total summed
+    # volume stays 8 (== leaf size) but [4, 8) is uncovered.
+    ents[1].update(ents[0])
+    json.dump(manifest, open(mpath, "w"))
+    with pytest.raises(CheckpointCorruptError, match="cover"):
+        load_sharded(path)
+    report = verify_checkpoint(path)
+    assert any("cover" in e for e in report["errors"]), report
+
+
+def test_doctor_recoverable_aside_copy(tmp_path):
+    """A crash between the two renames of a re-save swap leaves the
+    only good copy at *.old.tmp: scan marks it recoverable, doctor
+    names the rename-back, and renaming it back restores resume."""
+    from ray_tpu.train.checkpoint import CheckpointManager
+    from ray_tpu.train.sharded_checkpoint import save_sharded
+    from ray_tpu.util.checkpoint_fs import scan_run_dir
+    from ray_tpu.util.doctor import find_checkpoint_risk
+
+    run = str(tmp_path / "run")
+    os.makedirs(run)
+    save_sharded(os.path.join(run, "checkpoint_000001"),
+                 {"w": np.ones((2, 2), np.float32)})
+    # Simulate the swap window: a committed 000002 renamed aside,
+    # its final name never re-created.
+    final = os.path.join(run, "checkpoint_000002")
+    save_sharded(final, {"w": np.full((2, 2), 2.0, np.float32)})
+    aside = final + ".old.tmp"
+    os.rename(final, aside)
+
+    entries = scan_run_dir(run)
+    old = [e for e in entries if e.get("old")]
+    assert len(old) == 1
+    assert old[0]["recoverable"]
+    assert old[0]["final"] == "checkpoint_000002"
+    # Readers still ignore the aside dir (no torn resume).
+    latest = CheckpointManager.find_latest_in(run)
+    assert os.path.basename(latest.path) == "checkpoint_000001"
+
+    scans = [{"run_dir": run, "entries": entries}]
+    out = find_checkpoint_risk(scans, None, 30.0, now=time.time())
+    rec = [f for f in out if f["check"] == "recoverable_checkpoint"]
+    assert len(rec) == 1
+    assert "checkpoint_000002" in rec[0]["summary"]
+    assert "mv " in rec[0]["probe"]
+    # The probe tells the operator to verify the aside dir — verify
+    # must check its CONTENT, not short-circuit on the .tmp suffix.
+    from ray_tpu.util.checkpoint_fs import verify_checkpoint
+
+    vr = verify_checkpoint(aside)
+    assert vr["ok"] and vr["aside"], vr
+
+    # The operator's recovery: rename back -> finding clears, resume
+    # lands on the recovered step.
+    os.rename(aside, final)
+    scans = [{"run_dir": run, "entries": scan_run_dir(run)}]
+    assert not find_checkpoint_risk(scans, None, 30.0,
+                                    now=time.time())
+    latest = CheckpointManager.find_latest_in(run)
+    assert os.path.basename(latest.path) == "checkpoint_000002"
+
+    # Leftover aside NEXT TO a committed final: just stale-staging
+    # debris once old enough, never "recoverable".
+    save_sharded(final, {"w": np.ones((2, 2), np.float32)})
+    os.makedirs(aside)
+    os.utime(aside, (time.time() - 600, time.time() - 600))
+    scans = [{"run_dir": run, "entries": scan_run_dir(run)}]
+    out = find_checkpoint_risk(scans, None, 30.0, now=time.time())
+    assert all(f["check"] == "torn_checkpoint" for f in out)
+    assert any(f["data"]["name"] == "checkpoint_000002.old.tmp"
+               for f in out)
+
+
 def test_doctor_save_stats_merging():
     from ray_tpu.util.doctor import _checkpoint_save_stats
 
@@ -556,6 +830,30 @@ def test_doctor_save_stats_merging():
     # p99 lands in the +Inf bucket -> reported at the last boundary.
     assert stats["p99"] == 10.0
     assert _checkpoint_save_stats({"w1": [{"name": "other"}]}) is None
+
+
+def test_doctor_save_stats_groups_mismatched_boundaries():
+    """Sources reporting different bucket boundaries must not have
+    their counts summed against one boundary list — each layout gets
+    its own quantile and the worst p99 wins (the grace check must not
+    be computed from a skewed histogram)."""
+    from ray_tpu.util.doctor import _checkpoint_save_stats
+
+    fast = {"name": "rt_train_checkpoint_save_seconds",
+            "boundaries": [0.1, 1.0, 10.0],
+            "series": [{"tags": {"sharded": "1"},
+                        "hist": {"count": 99,
+                                 "buckets": [99, 0, 0, 0]}}]}
+    slow = {"name": "rt_train_checkpoint_save_seconds",
+            "boundaries": [5.0, 50.0],
+            "series": [{"tags": {"sharded": "0"},
+                        "hist": {"count": 1,
+                                 "buckets": [0, 1, 0]}}]}
+    stats = _checkpoint_save_stats({"a": [fast], "b": [slow]})
+    assert stats["count"] == 100
+    # Naive merging would bury the slow source's observation in the
+    # fast source's first bucket (p99 = 0.1); grouped, it surfaces.
+    assert stats["p99"] == 50.0
 
 
 def test_telemetry_checkpoint_section_render():
