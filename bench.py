@@ -453,26 +453,42 @@ def serve_llm() -> None:
                            engine_cfg=engine_cfg),
             route_prefix="/llm")
         # Warm the full path (replica __init__ already compiled the
-        # engine; this warms the handle/stream plumbing).
+        # engine; this warms the handle/stream plumbing and the
+        # pad-16 prefill shape).  "warmup" keeps its compile-laden
+        # prefill out of the engine's TTFT/TPOT accounting.
         _ = [f for f in handle.stream(
-            {"prompt": prompts[0], "max_tokens": 4})]
+            {"prompt": prompts[0], "max_tokens": 4,
+             "warmup": True})]
 
-        ttfts, counts, errors = [], [], []
+        ttfts, counts, errors, tpots = [], [], [], []
         lock = threading.Lock()
 
         def client(idx: int) -> None:
+            from ray_tpu.util import tracing
+
             for r in range(per_client):
                 payload = {"prompt": prompts[idx * per_client + r],
                            "max_tokens": max_tokens}
                 t0 = time.perf_counter()
-                first, n = None, 0
+                first, n, prev = None, 0, None
+                gaps = []
                 try:
-                    for fr in handle.stream(payload):
+                    # request_id on: the run measures throughput WITH
+                    # request tracing active (waiting/prefill/decode
+                    # spans + TPOT), so the recorded tokens/s floor
+                    # bounds the tracing overhead.
+                    for fr in handle.stream(
+                            payload,
+                            request_id=tracing.new_request_id()):
                         if "error" in fr:
                             raise RuntimeError(fr["error"])
                         if "token" in fr:
+                            now = time.perf_counter()
                             if first is None:
-                                first = time.perf_counter() - t0
+                                first = now - t0
+                            elif prev is not None:
+                                gaps.append(now - prev)
+                            prev = now
                             n += 1
                 except Exception as e:  # noqa: BLE001
                     with lock:
@@ -481,6 +497,7 @@ def serve_llm() -> None:
                 with lock:
                     ttfts.append(first)
                     counts.append(n)
+                    tpots.extend(gaps)
 
         threads = [threading.Thread(target=client, args=(i,))
                    for i in range(concurrency)]
@@ -501,6 +518,20 @@ def serve_llm() -> None:
     ttft_ms = np.asarray(sorted(ttfts)) * 1e3
     p50 = float(np.percentile(ttft_ms, 50))
     p99 = float(np.percentile(ttft_ms, 99))
+    tpot_ms = np.asarray(sorted(tpots)) * 1e3 if tpots else \
+        np.asarray([0.0])
+    tpot_p50 = float(np.percentile(tpot_ms, 50))
+    tpot_p99 = float(np.percentile(tpot_ms, 99))
+    # TTFT phase decomposition from the engine's own accounting:
+    # where the mean first token actually waited.
+    n_req = max(stats.get("ttft_requests", 0), 1)
+    wait_ms = 1e3 * stats.get("ttft_waiting_s_total", 0.0) / n_req
+    prefill_ms = 1e3 * stats.get("ttft_prefill_s_total", 0.0) / n_req
+    print(f"ttft decomposition (engine means over "
+          f"{stats.get('ttft_requests', 0)} request(s)): "
+          f"engine_waiting {wait_ms:.1f}ms + prefill "
+          f"{prefill_ms:.1f}ms of ttft p50 {p50:.1f}ms; "
+          f"tpot p50 {tpot_p50:.2f}ms p99 {tpot_p99:.2f}ms")
     import os
 
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
@@ -515,6 +546,10 @@ def serve_llm() -> None:
         "extra": {
             "ttft_p50_ms": round(p50, 1),
             "ttft_p99_ms": round(p99, 1),
+            "tpot_p50_ms": round(tpot_p50, 2),
+            "tpot_p99_ms": round(tpot_p99, 2),
+            "ttft_engine_waiting_mean_ms": round(wait_ms, 2),
+            "ttft_prefill_mean_ms": round(prefill_ms, 2),
             "requests": len(counts),
             "concurrency": concurrency,
             "kv_pages_used_after": stats["kv_pages_used"],
@@ -527,6 +562,9 @@ def serve_llm() -> None:
         {"benchmark": "serve_llm_ttft_p50_ms", "value": round(p50, 1),
          "unit": "ms", "higher_is_better": False},
         {"benchmark": "serve_llm_ttft_p99_ms", "value": round(p99, 1),
+         "unit": "ms", "higher_is_better": False},
+        {"benchmark": "serve_llm_tpot_p99_ms",
+         "value": round(tpot_p99, 2),
          "unit": "ms", "higher_is_better": False}])
 
 

@@ -191,10 +191,11 @@ class RemoteFunction:
             scheduling=_strategy(opts),
             runtime_env=opts["runtime_env"],
         )
-        if cfg.tracing_enabled:
-            from ..util import tracing
+        from ..util import tracing
 
-            tracing.inject(spec)
+        # Injected when tracing is on OR a serve request context is
+        # active (request-scoped tracing works without the flag).
+        tracing.maybe_inject(spec, cfg.tracing_enabled)
         refs = rt.submit_task(spec)
         if spec.is_streaming:
             return refs[0]  # an ObjectRefGenerator
@@ -300,10 +301,9 @@ class ActorHandle:
             unordered=self._has_groups,
             name=f"{self._class_name}.{method}",
         )
-        if rt.config.tracing_enabled:
-            from ..util import tracing
+        from ..util import tracing
 
-            tracing.inject(spec)
+        tracing.maybe_inject(spec, rt.config.tracing_enabled)
         refs = rt.submit_actor_task(spec)
         if spec.is_streaming:
             return refs[0]  # an ObjectRefGenerator

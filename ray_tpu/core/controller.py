@@ -145,6 +145,16 @@ class Controller:
         self.span_records: "_deque[Dict]" = _deque(
             maxlen=self.config.task_event_buffer_size)
         self.spans_received = 0
+        # Slowest-request exemplars per window, fed from finished
+        # ingress spans as they arrive — `rt trace` (no argument) and
+        # the doctor's find_slow_requests read this instead of
+        # re-scanning the whole span sink.
+        from ray_tpu.util.reqtrace import ExemplarRing
+
+        self.request_exemplar_ring = ExemplarRing(
+            capacity=int(os.environ.get("RT_TRACE_EXEMPLARS", "32")),
+            window_s=float(os.environ.get(
+                "RT_TRACE_EXEMPLAR_WINDOW_S", "600")))
         # On-demand profiler artifacts (e.g. jax.profiler trace dirs)
         # reported by node agents after an `rt profile --jax` capture.
         self.profile_artifacts: "_deque[Dict]" = _deque(maxlen=64)
@@ -179,6 +189,7 @@ class Controller:
             "metrics_history", "get_load_metrics", "worker_logs",
             "telemetry", "report_flight_dump",
             "report_spans", "list_spans", "report_profile",
+            "request_exemplars",
             "explain_task", "collective_entries",
             "report_autoscaler_decision", "doctor_feed",
             "job_register", "jobs_overview", "preempt_job",
@@ -1441,7 +1452,31 @@ class Controller:
                 s["node_id"] = node
             self.span_records.append(s)
             self.spans_received += 1
+            # Finished ingress spans feed the slow-request exemplar
+            # ring (request id + duration + deployment + dominant-
+            # phase inputs live in the sink for assembly on demand).
+            if s.get("name") == "ingress":
+                tags = s.get("tags") or {}
+                rid = tags.get("request_id")
+                if rid:
+                    try:
+                        self.request_exemplar_ring.offer(
+                            rid,
+                            max(float(s.get("end", 0.0))
+                                - float(s.get("start", 0.0)), 0.0),
+                            deployment=tags.get("deployment", "?"),
+                            ts=time.time(),
+                            outcome=tags.get("outcome", "?"),
+                            status_class=tags.get("status_class", "?"))
+                    except Exception:
+                        pass  # observability must never fail the relay
         return {"ok": True}
+
+    async def request_exemplars(self, p):
+        """Slowest-request exemplars in the current window (slowest
+        first) — the `rt trace` listing and find_slow_requests feed."""
+        return {"exemplars": self.request_exemplar_ring.snapshot(),
+                "window_s": self.request_exemplar_ring.window_s}
 
     async def list_spans(self, p):
         limit = (p or {}).get("limit", 10000)

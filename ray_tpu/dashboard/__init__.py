@@ -69,6 +69,8 @@ async function refresh() {
     `<a href="/api/telemetry?format=text">/api/telemetry</a> ` +
     `(goodput/MFU) · ` +
     `<a href="/api/doctor?format=text">/api/doctor</a> (health) · ` +
+    `<a href="/api/slo?format=text">/api/slo</a> (error budgets) · ` +
+    `<a href="/api/trace">/api/trace</a> (slow requests) · ` +
     `<a href="/api/timeline">/api/timeline</a> (Perfetto trace)</p>`;
 }
 refresh(); setInterval(refresh, 3000);
@@ -160,6 +162,33 @@ def create_app(address: Optional[str] = None):
                                 content_type="text/plain")
         return web.json_response(
             json.loads(json.dumps(diag, default=repr)))
+
+    async def slo(req):
+        """/api/slo — the SLO / error-budget report (`rt slo` JSON):
+        per-objective burn rates, budget consumed, p99 vs target.
+        ?format=text renders the CLI report."""
+        from ..util import slo as slo_mod
+
+        rep = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: slo_mod.report(address=address))
+        if req.query.get("format") == "text":
+            return web.Response(text=slo_mod.render_text(rep),
+                                content_type="text/plain")
+        return web.json_response(
+            json.loads(json.dumps(rep, default=repr)))
+
+    async def trace(req):
+        """/api/trace?id=<request_id> — one request's cross-process
+        hop chain (`rt trace` JSON); without ?id, the slowest-request
+        exemplar listing."""
+        rid = req.query.get("id")
+        if rid:
+            data = await call(state_api.request_trace,
+                              request_id=rid)
+        else:
+            data = await call(state_api.request_exemplars)
+        return web.json_response(
+            json.loads(json.dumps(data, default=repr)))
 
     async def timeline(req):
         """/api/timeline — the unified cluster timeline as Chrome-trace
@@ -290,6 +319,8 @@ def create_app(address: Optional[str] = None):
     app.router.add_get("/api/doctor", doctor)
     app.router.add_get("/api/telemetry", telemetry)
     app.router.add_get("/api/timeline", timeline)
+    app.router.add_get("/api/slo", slo)
+    app.router.add_get("/api/trace", trace)
     app.router.add_get("/timeseries", timeseries)
     app.router.add_get("/api/timeseries", timeseries_json)
     return app

@@ -74,10 +74,14 @@ class _Sequence:
 
     __slots__ = ("sid", "tokens", "prompt_len", "max_tokens", "params",
                  "rng", "out", "pages", "n_cached", "generated",
-                 "finished", "cancelled", "submitted_ts")
+                 "finished", "cancelled", "submitted_ts",
+                 "request_id", "first_token_ts", "last_token_ts",
+                 "warmup")
 
     def __init__(self, sid: int, prompt: List[int], max_tokens: int,
-                 params: SamplingParams, seed: int):
+                 params: SamplingParams, seed: int,
+                 request_id: Optional[str] = None,
+                 warmup: bool = False):
         self.sid = sid
         self.tokens = list(prompt)      # prompt + generated so far
         self.prompt_len = len(prompt)
@@ -91,6 +95,16 @@ class _Sequence:
         self.finished = False
         self.cancelled = False
         self.submitted_ts = time.time()
+        # Request tracing (minted at the serve ingress): lifecycle
+        # spans — waiting-queue, prefill, decode — tag this id so
+        # `rt trace <id>` shows where a request's TTFT went.
+        self.request_id = request_id
+        self.first_token_ts: Optional[float] = None
+        self.last_token_ts: Optional[float] = None
+        # Warmup sequences pay the prefill/decode COMPILES: their
+        # multi-second samples must not enter the TTFT-phase/TPOT
+        # accounting real traffic is judged by.
+        self.warmup = warmup
 
 
 class GenerationEngine:
@@ -163,12 +177,21 @@ class GenerationEngine:
         self._prefill_tokens_total = 0
         self._evictions = 0
         self._seq_seed = seed
+        # TTFT phase accounting (engine-side): waiting-queue + prefill
+        # totals feed bench.py's decomposition print; TPOT (inter-
+        # token gap) sums feed the serve_llm_tpot_p99_ms ledger row.
+        self._waiting_s_total = 0.0
+        self._prefill_s_total = 0.0
+        self._ttft_requests = 0
+        self._tpot_s_total = 0.0
+        self._tpot_count = 0
         # Metric handles cached once: the registry dedupes by name, but
         # re-constructing a Metric per emitted token would pay name
         # validation + the global registry lock ~1k times/s.
         self._metrics = {}
         try:
-            from ..util.metrics import Counter, Gauge
+            from ..util.metrics import (Counter, Gauge, Histogram,
+                                        ttft_phase_histogram)
 
             self._metrics = {
                 "tokens": Counter("rt_llm_tokens_total",
@@ -185,6 +208,10 @@ class GenerationEngine:
                     "Sequences in the decode batch this engine step."),
                 "waiting": Gauge("rt_llm_waiting",
                                  "Sequences queued for admission."),
+                "tpot": Histogram(
+                    "rt_llm_tpot_seconds",
+                    "Inter-token (time-per-output-token) gap."),
+                "ttft_phase": ttft_phase_histogram(),
             }
         except Exception:
             pass
@@ -208,9 +235,13 @@ class GenerationEngine:
     def submit(self, prompt: List[int],
                max_tokens: Optional[int] = None,
                params: Optional[SamplingParams] = None,
-               seed: Optional[int] = None) -> _Sequence:
+               seed: Optional[int] = None,
+               request_id: Optional[str] = None,
+               _warmup: bool = False) -> _Sequence:
         """Queue one generation request; returns its sequence handle
-        (stream its frames with ``frames()``)."""
+        (stream its frames with ``frames()``).  ``request_id`` opts
+        the sequence into request tracing: waiting/prefill/decode
+        spans tagged with the id, plus TTFT-phase histograms."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -226,7 +257,8 @@ class GenerationEngine:
         seq = _Sequence(sid, prompt,
                         max_tokens or self.cfg.max_tokens_default,
                         params or SamplingParams(),
-                        self._seq_seed + sid if seed is None else seed)
+                        self._seq_seed + sid if seed is None else seed,
+                        request_id=request_id, warmup=_warmup)
         with self._wake:
             self._seqs[sid] = seq
             self._waiting.append(seq)
@@ -274,9 +306,11 @@ class GenerationEngine:
     def generate(self, prompt: List[int],
                  max_tokens: Optional[int] = None,
                  params: Optional[SamplingParams] = None,
-                 seed: Optional[int] = None) -> List[int]:
+                 seed: Optional[int] = None,
+                 request_id: Optional[str] = None) -> List[int]:
         """Blocking convenience: submit and collect all tokens."""
-        seq = self.submit(prompt, max_tokens, params, seed)
+        seq = self.submit(prompt, max_tokens, params, seed,
+                          request_id=request_id)
         out: List[int] = []
         for fr in self.frames(seq):
             if "token" in fr:
@@ -292,7 +326,10 @@ class GenerationEngine:
         running = self._thread is not None and self._thread.is_alive()
         if not running:
             self.start()
-        self.generate([0, 1], max_tokens=2)
+        seq = self.submit([0, 1], max_tokens=2, _warmup=True)
+        for fr in self.frames(seq):
+            if "error" in fr:
+                raise RuntimeError(fr["error"])
         if not running:
             self.stop()
 
@@ -311,6 +348,12 @@ class GenerationEngine:
                 "max_context": self.max_context,
                 "step_errors": self._step_errors,
                 "last_error": self._last_error,
+                # TTFT phase + TPOT accounting (bench decomposition).
+                "ttft_requests": self._ttft_requests,
+                "ttft_waiting_s_total": self._waiting_s_total,
+                "ttft_prefill_s_total": self._prefill_s_total,
+                "tpot_s_total": self._tpot_s_total,
+                "tpot_count": self._tpot_count,
             }
 
     # ------------------------------------------------------ engine loop
@@ -417,6 +460,19 @@ class GenerationEngine:
 
     def _prefill(self, seq: _Sequence) -> None:
         n = len(seq.tokens)
+        # First admission only (a recompute-preempted sequence
+        # re-prefills but already emitted its first token — its
+        # waiting/prefill phases were accounted the first time), and
+        # never the warmup sequence (it pays the compiles).
+        first_admission = seq.generated == 0 and not seq.warmup
+        t_admit = time.time()
+        if first_admission:
+            waited = max(t_admit - seq.submitted_ts, 0.0)
+            self._waiting_s_total += waited
+            self._ttft_requests += 1
+            self._observe_phase("engine_waiting", waited)
+            self._req_span(seq, "engine_waiting", seq.submitted_ts,
+                           t_admit)
         pad = _bucket(n)
         tokens = np.zeros((1, pad), np.int32)
         tokens[0, :n] = seq.tokens
@@ -433,6 +489,13 @@ class GenerationEngine:
         with self._lock:
             self._running.append(seq)
         self._emit_token(seq, np.asarray(logits[0, n - 1]))
+        if first_admission:
+            t_first = time.time()
+            self._prefill_s_total += t_first - t_admit
+            self._observe_phase("prefill", t_first - t_admit)
+            self._req_span(seq, "prefill", t_admit, t_first,
+                           tags={"prompt_tokens": n})
+            seq.first_token_ts = t_first
 
     def _decode_step(self) -> None:
         """One batched decode forward over every running sequence."""
@@ -506,6 +569,18 @@ class GenerationEngine:
         seq.generated += 1
         self._tokens_total += 1
         self._count("tokens")
+        now = time.time()
+        if seq.generated > 1 and seq.last_token_ts is not None \
+                and not seq.warmup:
+            gap = max(now - seq.last_token_ts, 0.0)
+            self._tpot_s_total += gap
+            self._tpot_count += 1
+            try:
+                if self._metrics:
+                    self._metrics["tpot"].observe(gap)
+            except Exception:
+                pass
+        seq.last_token_ts = now
         seq.out.put({"token": tok, "index": seq.generated - 1})
         eos = self.cfg.eos_id is not None and tok == self.cfg.eos_id
         # n_cached is the NEXT write position: continuing needs it
@@ -525,11 +600,44 @@ class GenerationEngine:
         self.pool.free(seq.pages)
         seq.pages = []
         self._seqs.pop(seq.sid, None)
+        if seq.first_token_ts is not None and \
+                seq.last_token_ts is not None and seq.generated > 1:
+            self._req_span(seq, "decode", seq.first_token_ts,
+                           seq.last_token_ts,
+                           tags={"tokens": seq.generated,
+                                 "reason": error or reason})
         if error is not None:
             seq.out.put({"error": error})
         else:
             seq.out.put({"done": True, "reason": reason,
                          "n_tokens": seq.generated})
+
+    def _req_span(self, seq: _Sequence, name: str, start: float,
+                  end: float, tags: Optional[Dict[str, Any]] = None
+                  ) -> None:
+        """Record one lifecycle span for a request-traced sequence
+        (no-op otherwise — untraced traffic pays nothing).  The span
+        lands in the replica process's ring; the worker flush loop
+        ships it to the controller sink for `rt trace`."""
+        if not seq.request_id:
+            return
+        try:
+            from ..util import spans
+
+            spans.record_span(
+                name, start, end, cat="llm",
+                tags={"request_id": seq.request_id, "seq": seq.sid,
+                      **(tags or {})})
+        except Exception:
+            pass
+
+    def _observe_phase(self, phase: str, seconds: float) -> None:
+        try:
+            if self._metrics:
+                self._metrics["ttft_phase"].observe(
+                    seconds, tags={"phase": phase})
+        except Exception:
+            pass
 
     # -------------------------------------------------------- metrics
     def _publish_gauges(self) -> None:

@@ -86,6 +86,13 @@ class LLMDeployment:
     def __call__(self, payload: Optional[Dict[str, Any]]):
         engine = self._engine_or_raise()
         payload = payload or {}
+        # Request tracing: the ingress-minted id arrives through the
+        # injected span context (the replica adopts it around task
+        # execution); handing it to the engine opts this sequence into
+        # waiting/prefill/decode lifecycle spans for `rt trace <id>`.
+        from ..util import tracing
+
+        rid = tracing.current_request_id()
         try:
             prompt = [int(t) for t in payload["prompt"]]
             params = SamplingParams(
@@ -96,7 +103,13 @@ class LLMDeployment:
                 prompt,
                 max_tokens=payload.get("max_tokens"),
                 params=params,
-                seed=payload.get("seed"))
+                seed=payload.get("seed"),
+                request_id=rid,
+                # {"warmup": true} opts a request out of the TTFT/
+                # TPOT accounting (clients priming compile shapes —
+                # e.g. bench's handle-path warm call — must not skew
+                # the decomposition real traffic is judged by).
+                _warmup=bool(payload.get("warmup")))
         except (KeyError, TypeError, ValueError) as e:
             yield {"error": f"bad request: {e!r}"}
             return

@@ -430,6 +430,71 @@ def cmd_profile(args) -> int:
     return 0 if captured else 1
 
 
+def cmd_trace(args) -> int:
+    """Request-scoped tracing: with an id (prefix ok), print one
+    request's cross-process hop chain — proxy ingress, admission
+    wait, each failover attempt (replica + breaker state), replica
+    execution, and the engine's waiting/prefill/decode phases — with
+    the TTFT breakdown and dominant phase.  Without an id, list the
+    slowest-request exemplars in the current window."""
+    from ray_tpu.util import state as state_api
+    from ray_tpu.util.reqtrace import render_trace
+
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    if not args.request_id:
+        r = state_api.request_exemplars(address=address)
+        rows = r.get("exemplars") or []
+        if args.format == "json":
+            print(json.dumps(r, indent=2, default=repr))
+            return 0
+        if not rows:
+            print("(no request exemplars in the window — serve "
+                  "traffic records ingress spans automatically)")
+            return 0
+        print(f"slowest requests (last {r.get('window_s', 0):.0f}s "
+              f"window, slowest first):")
+        for rec in rows:
+            print(f"  {rec['request_id']:<18} "
+                  f"{rec['duration_s'] * 1e3:9.1f}ms  "
+                  f"{rec.get('deployment', '?'):<16} "
+                  f"{rec.get('status_class', '?')}")
+        print("\ninspect one with: rt trace <request_id>")
+        return 0
+    trace = state_api.request_trace(args.request_id, address=address)
+    if args.format == "json":
+        print(json.dumps(trace, indent=2, default=repr))
+        return 0 if trace.get("found") else 1
+    if trace.get("ambiguous"):
+        print(f"request id prefix {args.request_id!r} is ambiguous: "
+              f"{', '.join(trace['ambiguous'])}", file=sys.stderr)
+        return 1
+    sys.stdout.write(render_trace(trace))
+    return 0 if trace.get("found") else 1
+
+
+def cmd_slo(args) -> int:
+    """SLO / error-budget plane: every declared objective (plus the
+    default availability objective for deployments with traffic)
+    evaluated from metrics history with multi-window burn rates —
+    the `rt doctor` SLO findings' data, rendered as a report."""
+    from ray_tpu.util import slo as slo_mod
+
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    rep = slo_mod.report(address=address)
+    if args.format == "json":
+        print(json.dumps(rep, indent=2, default=repr))
+    else:
+        sys.stdout.write(slo_mod.render_text(rep))
+    worst = rep.get("worst")
+    return 1 if worst in ("exhausted", "fast_burn") else 0
+
+
 def cmd_doctor(args) -> int:
     """Aggregated cluster health diagnosis: dead-owner leases,
     never-idle nodes, infeasible placement groups, hung collectives
@@ -958,6 +1023,25 @@ def _build_parser() -> argparse.ArgumentParser:
                          "loaded it yet")
     sp.add_argument("--address", default="")
     sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser("trace",
+                        help="follow one request ingress->decode "
+                             "(no id: list slowest exemplars)")
+    sp.add_argument("request_id", nargs="?", default="",
+                    help="request id (prefix ok; from the "
+                         "X-RT-Request-Id response header)")
+    sp.add_argument("--address", default="")
+    sp.add_argument("--format", choices=["text", "json"],
+                    default="text")
+    sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("slo",
+                        help="SLO / error-budget report (burn rates, "
+                             "budget consumed, p99 vs target)")
+    sp.add_argument("--address", default="")
+    sp.add_argument("--format", choices=["text", "json"],
+                    default="text")
+    sp.set_defaults(fn=cmd_slo)
 
     sp = sub.add_parser("doctor",
                         help="aggregated cluster health diagnosis "

@@ -25,7 +25,8 @@ class _Replica:
     """Hosts one replica of a deployment (class instance or function)."""
 
     def __init__(self, cls_payload: bytes, init_args: tuple,
-                 init_kwargs: dict, is_function: bool):
+                 init_kwargs: dict, is_function: bool,
+                 deployment: str = "?"):
         import asyncio
         import threading
 
@@ -33,6 +34,7 @@ class _Replica:
 
         target = cloudpickle.loads(cls_payload)
         self._is_function = is_function
+        self._deployment = deployment
         # Autoscaling decisions ride on this counter and the replica runs
         # with max_concurrency=32, so guard it with a real lock instead
         # of relying on CPython's GIL making `+= 1` atomic-enough.
@@ -97,11 +99,34 @@ class _Replica:
                 "HTTP chunked)")
         return result
 
+    def _exec_span(self):
+        """Replica execution span for request-traced unary calls: the
+        request id arrives via the injected trace context (the PR-2
+        contextvar plane), so spans recorded here auto-tag it and the
+        worker's flush loop ships them to the controller sink.  A
+        no-op (zero allocation beyond one contextvar read) for plain
+        untraced traffic.  The streaming path records its span
+        manually in handle_request_stream's finally — the handler
+        body runs as frames are pulled, past this scope."""
+        import contextlib
+
+        from ..util import spans, tracing
+
+        if tracing.current_request_id() is None:
+            return contextlib.nullcontext()
+        import os as _os
+
+        return spans.span("replica_exec", cat="serve",
+                          tags={"deployment": self._deployment,
+                                "replica_pid": _os.getpid(),
+                                "streaming": 0})
+
     def handle_request(self, args: tuple, kwargs: dict):
         self._enter()
         try:
             target = self._fn if self._is_function else self._instance
-            return self._finish(target(*args, **kwargs))
+            with self._exec_span():
+                return self._finish(target(*args, **kwargs))
         finally:
             self._exit()
 
@@ -122,8 +147,19 @@ class _Replica:
         ongoing request for autoscaling/drain for its whole life."""
         import inspect
 
+        import time as _time
+
+        from ..util import tracing
+
         self._enter()
         self._open_streams += 1
+        # Span the WHOLE drive, not just generator creation: the
+        # handler body of a generator deployment executes as the
+        # frames are pulled, which is where a streamed request's
+        # replica-side time actually goes.  Recorded in the finally
+        # (the span ring wants finished spans), traced requests only.
+        rid = tracing.current_request_id()
+        t0 = _time.time()
         try:
             target = self._fn if self._is_function else self._instance
             result = target(*args, **kwargs)
@@ -142,6 +178,19 @@ class _Replica:
         finally:
             self._open_streams -= 1
             self._exit()
+            if rid:
+                try:
+                    import os as _os
+
+                    from ..util import spans
+
+                    spans.record_span(
+                        "replica_exec", t0, _time.time(), cat="serve",
+                        tags={"deployment": self._deployment,
+                              "replica_pid": _os.getpid(),
+                              "request_id": rid, "streaming": 1})
+                except Exception:
+                    pass
 
     def ongoing(self) -> int:
         return self._ongoing
@@ -408,7 +457,7 @@ class ServeController:
                 args, kwargs = entry["init"]
                 entry["replicas"].append(replica_cls.remote(
                     entry["payload"], args, kwargs,
-                    entry["is_function"]))
+                    entry["is_function"], deployment=name))
             while len(entry["replicas"]) > entry["target"]:
                 victim = entry["replicas"].pop()
                 # Drain, don't kill: in-flight requests finish; the
@@ -440,7 +489,8 @@ class ServeController:
             replica_cls = ray_tpu.remote(_Replica).options(
                 max_concurrency=32, **entry.get("actor_options", {}))
             entry["replicas"][index] = replica_cls.remote(
-                entry["payload"], args, kwargs, entry["is_function"])
+                entry["payload"], args, kwargs, entry["is_function"],
+                deployment=name)
             self._log_replacement_locked(entry, index, reason)
             self._bump_version_locked()
             return True
@@ -682,6 +732,31 @@ class DeploymentHandle:
         except Exception:
             pass
 
+    def _attempt_span(self, rid: Optional[str], key: str,
+                      attempt: int, t0: float, outcome: str) -> None:
+        """One failover attempt's span (request-traced calls only):
+        which replica, which try, the breaker's state, how it ended."""
+        if not rid:
+            return
+        try:
+            from ..util import spans
+
+            spans.record_span(
+                "attempt", t0, time.time(), cat="serve",
+                tags={"deployment": self.deployment_name,
+                      "request_id": rid, "replica": key[:12],
+                      "attempt": attempt,
+                      "breaker": self._breakers.state(key),
+                      "outcome": outcome})
+        except Exception:
+            pass
+
+    @staticmethod
+    def _observe_phase(phase: str, seconds: float) -> None:
+        from ..util.metrics import observe_ttft_phase
+
+        observe_ttft_phase(phase, seconds)
+
     def _on_breaker_transition(self, key: str, state: str) -> None:
         """Breaker trip/close: export the per-replica state gauge and
         tell the serve controller (fire-and-forget) so `rt doctor` /
@@ -862,7 +937,7 @@ class DeploymentHandle:
 
     # ------------------------------------------------- resilient call
     def call(self, *args, timeout_s: Optional[float] = None,
-             **kwargs):
+             request_id: Optional[str] = None, **kwargs):
         """Resilient unary call: admission control, one deadline
         spanning everything, and transparent failover — a dispatch
         that dies with a SYSTEM fault (replica/worker death, lost
@@ -871,14 +946,22 @@ class DeploymentHandle:
         deadline.  Blocks until the result; raises
         ``RequestShedError`` / ``RequestTimeoutError`` /
         ``ReplicasUnavailableError`` (the ingress maps them to
-        429/504/503) or the handler's own exception."""
+        429/504/503) or the handler's own exception.
+
+        ``request_id`` (minted at the ingress, or any caller-supplied
+        id) opens a request-tracing scope: the admission wait and
+        every failover attempt record spans tagged with the id, and
+        the id rides the actor-task hop into the replica/engine."""
         from ..core.errors import GetTimeoutError
+        from ..util import spans, tracing
         from .resilience import (Deadline, RequestShedError,
                                  RequestTimeoutError, is_system_fault)
 
+        rid = request_id or tracing.current_request_id()
         deadline = Deadline(self._timeout_s if timeout_s is None
                             else timeout_s)
         self._ensure_fresh()
+        t_admit = time.time()
         try:
             admission = self._gate.admit(deadline,
                                          self.deployment_name)
@@ -891,19 +974,33 @@ class DeploymentHandle:
             self._inc("rt_serve_deadline_exceeded_total",
                       "Serve requests that exceeded their deadline.")
             raise
-        with admission:
+        finally:
+            waited = time.time() - t_admit
+            if rid:
+                spans.record_span(
+                    "admission_wait", t_admit, t_admit + waited,
+                    cat="serve",
+                    tags={"deployment": self.deployment_name,
+                          "request_id": rid})
+            self._observe_phase("admission_queue", waited)
+        with admission, tracing.request_scope(rid):
             tried: set = set()
             last_fault: Optional[BaseException] = None
             for attempt in range(self._max_retries + 1):
                 if deadline.expired:
                     break
                 replica, key = self._pick(exclude=tried, strict=True)
+                t_att = time.time()
                 ref = self._track(
                     replica.handle_request.remote(args, kwargs), key)
                 try:
-                    return ray_tpu.get(
+                    result = ray_tpu.get(
                         ref, timeout=deadline.remaining(cap=3600.0))
+                    self._attempt_span(rid, key, attempt, t_att, "ok")
+                    return result
                 except GetTimeoutError:
+                    self._attempt_span(rid, key, attempt, t_att,
+                                       "deadline")
                     # Budget exhausted mid-flight: stop the replica-
                     # side work and surface 504, not a retry (the
                     # client's deadline is gone either way).
@@ -922,8 +1019,12 @@ class DeploymentHandle:
                         self.deployment_name, deadline.timeout_s)
                 except Exception as e:  # noqa: BLE001
                     if not is_system_fault(e):
+                        self._attempt_span(rid, key, attempt, t_att,
+                                           "user_error")
                         raise  # the handler's own error: never retried
                     # _track's done-callback already fed the breaker.
+                    self._attempt_span(rid, key, attempt, t_att,
+                                       "system_fault")
                     last_fault = e
                     tried.add(key)
                     if attempt < self._max_retries:
@@ -971,7 +1072,8 @@ class DeploymentHandle:
 
         return gen, release
 
-    def stream(self, *args, **kwargs):
+    def stream(self, *args, request_id: Optional[str] = None,
+               **kwargs):
         """Call a deployment through the streaming path; yields items
         as the replica produces them over the core ObjectRefGenerator
         plane — no chunk polling (ref: handle.options(stream=True)).
@@ -984,24 +1086,32 @@ class DeploymentHandle:
         ``StreamInterruptedError`` — consumers can always distinguish
         an interrupted stream from a completed one.  The handler's own
         exceptions pass through unchanged, and the deadline bounds
-        dispatch + time-to-first-item (not total stream life)."""
-        return self._stream_impl(args, kwargs, self._timeout_s)
+        dispatch + time-to-first-item (not total stream life).
+
+        ``request_id`` (keyword-only, consumed here — not forwarded
+        to the handler) opts the stream into request tracing."""
+        return self._stream_impl(args, kwargs, self._timeout_s,
+                                 request_id=request_id)
 
     def stream_timed(self, timeout_s: Optional[float], *args,
-                     **kwargs):
-        """``stream()`` with a per-request deadline override (the
-        ingress propagation path)."""
+                     request_id: Optional[str] = None, **kwargs):
+        """``stream()`` with a per-request deadline override and an
+        optional request-tracing id (the ingress propagation path)."""
         return self._stream_impl(
             args, kwargs,
-            self._timeout_s if timeout_s is None else timeout_s)
+            self._timeout_s if timeout_s is None else timeout_s,
+            request_id=request_id)
 
     def _stream_impl(self, args: tuple, kwargs: dict,
-                     timeout_s: float):
+                     timeout_s: float,
+                     request_id: Optional[str] = None):
         from ..core.errors import GetTimeoutError
+        from ..util import tracing
         from .resilience import (Deadline, RequestTimeoutError,
                                  StreamInterruptedError,
                                  is_system_fault)
 
+        rid = request_id or tracing.current_request_id()
         deadline = Deadline(timeout_s)
         # Idle bound between items: streams live as long as frames
         # keep coming; the request deadline only governs the dispatch
@@ -1009,9 +1119,11 @@ class DeploymentHandle:
         item_timeout = max(timeout_s or 0.0, 120.0)
         tried: set = set()
         for attempt in range(self._max_retries + 1):
-            replica, key = self._pick(exclude=tried, strict=True)
-            gen = replica.handle_request_stream.options(
-                num_returns="streaming").remote(args, kwargs)
+            t_att = time.time()
+            with tracing.request_scope(rid):
+                replica, key = self._pick(exclude=tried, strict=True)
+                gen = replica.handle_request_stream.options(
+                    num_returns="streaming").remote(args, kwargs)
             delivered = 0
             try:
                 for ref in gen:
@@ -1020,6 +1132,12 @@ class DeploymentHandle:
                                else item_timeout)
                     item = ray_tpu.get(ref, timeout=timeout)
                     delivered += 1
+                    if delivered == 1:
+                        # Dispatch-to-first-frame span: the stream's
+                        # failover unit (post-first-frame faults are
+                        # typed interruptions, not retries).
+                        self._attempt_span(rid, key, attempt, t_att,
+                                           "first_frame")
                     yield item
                 self._breakers.record_success(key)
                 return
@@ -1044,11 +1162,18 @@ class DeploymentHandle:
                           "Serve requests that exceeded their "
                           "deadline.")
                 if delivered == 0:
+                    self._attempt_span(rid, key, attempt, t_att,
+                                       "deadline")
                     raise RequestTimeoutError(self.deployment_name,
                                               deadline.timeout_s)
                 raise StreamInterruptedError(
                     self.deployment_name, repr(e), delivered) from e
             except Exception as e:  # noqa: BLE001
+                if delivered == 0:
+                    self._attempt_span(
+                        rid, key, attempt, t_att,
+                        "system_fault" if is_system_fault(e)
+                        else "user_error")
                 if not is_system_fault(e):
                     # The handler's own error: the replica is alive
                     # and responding — a success signal breaker-wise.

@@ -19,9 +19,11 @@ both ingresses always agree on the table.
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Dict
 
 SERVICE = "ray_tpu.serve.Ingress"
+REQUEST_ID_KEY = "rt-request-id"
 
 
 class GRPCProxy:
@@ -32,10 +34,12 @@ class GRPCProxy:
 
         import grpc
 
+        from .proxy import _IngressTelemetry, clean_request_id
         from .routes import RouteTable
 
         self._handles: Dict[str, Any] = {}
         self._route_table = RouteTable()
+        self._telemetry = _IngressTelemetry(proto="grpc")
 
         def _resolve(req: Dict[str, Any]) -> str:
             if req.get("deployment"):
@@ -106,39 +110,130 @@ class GRPCProxy:
                     "/ray_tpu.serve.Ingress/CallStream")
             context.abort(_grpc.StatusCode.INTERNAL, repr(e))
 
+        def _rid_of(context) -> Any:
+            """The client's rt-request-id metadata (sanitized), or
+            None — the gRPC dual of the X-RT-Request-Id header."""
+            try:
+                for k, v in context.invocation_metadata() or ():
+                    if k == REQUEST_ID_KEY:
+                        return clean_request_id(v)
+            except Exception:
+                pass
+            return None
+
+        def _class_of_exc(e: BaseException) -> str:
+            from .resilience import (RequestShedError,
+                                     RequestTimeoutError)
+
+            if isinstance(e, RequestShedError):
+                return "shed"
+            if isinstance(e, RequestTimeoutError):
+                return "deadline"
+            if isinstance(e, KeyError):
+                return "4xx"
+            return "5xx"
+
         def call(request: bytes, context) -> bytes:
             import grpc as _grpc
 
+            from ..util import tracing
+
+            rid = _rid_of(context) or tracing.new_request_id()
             try:
-                req = json.loads(request or b"{}")
+                # Trailer: delivered on success AND on abort(), so the
+                # client can always quote the id (incl. 429/504 duals).
+                context.set_trailing_metadata(((REQUEST_ID_KEY, rid),))
+            except Exception:
+                pass
+            t0 = self._telemetry.begin()
+            tel = {"dep": "?", "cls": "5xx", "outcome": "error"}
+            try:
+                try:
+                    req = json.loads(request or b"{}")
+                except ValueError as e:
+                    # Malformed request bytes: the CLIENT's fault —
+                    # 4xx like the HTTP proxy's 400, never budget burn.
+                    tel["cls"], tel["outcome"] = "4xx", "bad_request"
+                    context.abort(_grpc.StatusCode.INVALID_ARGUMENT,
+                                  f"request is not JSON: {e}")
                 handle = _handle_for(_resolve(req))
+                tel["dep"] = handle.deployment_name
+                self._telemetry.observe_phase(
+                    "proxy", time.perf_counter() - t0)
                 result = handle.call(req.get("payload"),
                                      timeout_s=_timeout_of(req,
-                                                           context))
+                                                           context),
+                                     request_id=rid)
+                # Serialize INSIDE the try (with the HTTP proxy's
+                # repr fallback): a non-JSON-able handler result must
+                # not count as a served 2xx while the client errors.
+                try:
+                    out = json.dumps({"result": result}).encode()
+                except (TypeError, ValueError):
+                    out = json.dumps(
+                        {"result": repr(result)}).encode()
+                tel["cls"], tel["outcome"] = "2xx", "ok"
             except KeyError as e:
+                tel["cls"], tel["outcome"] = "4xx", "not_found"
                 context.abort(_grpc.StatusCode.NOT_FOUND, str(e))
             except Exception as e:  # noqa: BLE001 — surface to client
+                if tel["outcome"] == "bad_request":
+                    raise   # abort() already fired; don't re-abort
+                tel["cls"] = _class_of_exc(e)
                 _abort_typed(context, e)
-            return json.dumps({"result": result}).encode()
+            finally:
+                self._telemetry.end(t0, tel["dep"], tel["outcome"],
+                                    tel["cls"], rid)
+            return out
 
         def call_stream(request: bytes, context):
             import grpc as _grpc
 
+            from ..util import tracing
             from .resilience import (StreamInterruptedError,
                                      is_system_fault)
 
+            rid = _rid_of(context) or tracing.new_request_id()
+            try:
+                context.set_trailing_metadata(((REQUEST_ID_KEY, rid),))
+            except Exception:
+                pass
+            t0 = self._telemetry.begin()
+            tel = {"dep": "?", "cls": "5xx", "outcome": "error"}
             delivered = 0
             try:
-                req = json.loads(request or b"{}")
+                try:
+                    req = json.loads(request or b"{}")
+                except ValueError as e:
+                    tel["cls"], tel["outcome"] = "4xx", "bad_request"
+                    context.abort(_grpc.StatusCode.INVALID_ARGUMENT,
+                                  f"request is not JSON: {e}")
                 handle = _handle_for(_resolve(req))
+                tel["dep"] = handle.deployment_name
+                self._telemetry.observe_phase(
+                    "proxy", time.perf_counter() - t0)
                 for item in handle.stream_timed(
                         _timeout_of(req, context),
-                        req.get("payload")):
+                        req.get("payload"), request_id=rid):
                     delivered += 1
+                    if delivered == 1:
+                        self._telemetry.observe_ttft(
+                            tel["dep"], time.perf_counter() - t0)
                     yield json.dumps(item).encode()
+                tel["cls"], tel["outcome"] = "2xx", "ok"
+            except GeneratorExit:
+                # The CLIENT cancelled the stream: grpc closes the
+                # response generator.  Their choice, not a server
+                # failure — must not burn the availability budget.
+                tel["cls"], tel["outcome"] = "4xx", "disconnect"
+                raise
             except KeyError as e:
+                tel["cls"], tel["outcome"] = "4xx", "not_found"
                 context.abort(_grpc.StatusCode.NOT_FOUND, str(e))
             except Exception as e:  # noqa: BLE001
+                if tel["outcome"] == "bad_request":
+                    raise   # abort() already fired; don't re-abort
+                tel["cls"] = _class_of_exc(e)
                 if delivered == 0:
                     _abort_typed(context, e)
                 # Mid-stream failure: the typed trailer is how a gRPC
@@ -152,13 +247,19 @@ class GRPCProxy:
                             isinstance(e, StreamInterruptedError)),
                         "items_delivered": delivered}
                 try:
+                    # One call replaces the trailer set: carry the
+                    # request id alongside the error info.
                     context.set_trailing_metadata((
-                        ("rt-stream-error", json.dumps(info)),))
+                        ("rt-stream-error", json.dumps(info)),
+                        (REQUEST_ID_KEY, rid)))
                 except Exception:
                     pass
                 code = (_grpc.StatusCode.UNAVAILABLE if info["system"]
                         else _grpc.StatusCode.INTERNAL)
                 context.abort(code, repr(e))
+            finally:
+                self._telemetry.end(t0, tel["dep"], tel["outcome"],
+                                    tel["cls"], rid)
 
         ident = lambda b: b  # noqa: E731 — raw-bytes (de)serializer
         handlers = grpc.method_handlers_generic_handler(SERVICE, {

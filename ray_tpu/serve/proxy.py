@@ -15,15 +15,43 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+REQUEST_ID_HEADER = "X-RT-Request-Id"
+
+
+def status_class(status: int) -> str:
+    """Map an HTTP status to the SLO status class: 429 (admission
+    shed) and 504 (deadline exceeded) get their own classes — they
+    feed the PR-8 shed/deadline counters into error-budget math —
+    everything else buckets by hundreds (2xx/4xx/5xx)."""
+    if status == 429:
+        return "shed"
+    if status == 504:
+        return "deadline"
+    return f"{int(status) // 100}xx"
+
+
+def clean_request_id(raw: Optional[str]) -> Optional[str]:
+    """Sanitize a client-supplied request id: printable, bounded,
+    no whitespace — a hostile header must not corrupt span tags or
+    log lines.  None/empty returns None (caller mints one)."""
+    if not raw:
+        return None
+    rid = "".join(c for c in str(raw)[:64]
+                  if c.isalnum() or c in "-_.:")
+    return rid or None
+
 
 class _IngressTelemetry:
     """Per-proxy request metrics: latency histogram by deployment +
-    outcome, and an in-flight depth gauge (the proxy-side queue depth
-    — requests accepted but not yet answered)."""
+    outcome + status class, a per-status-class request counter (the
+    availability SLO's input), TTFT observations, and an in-flight
+    depth gauge (the proxy-side queue depth — requests accepted but
+    not yet answered)."""
 
-    def __init__(self):
+    def __init__(self, proto: str = "http"):
         self._lock = threading.Lock()
         self._inflight = 0
+        self._proto = proto
 
     def begin(self) -> float:
         with self._lock:
@@ -33,29 +61,66 @@ class _IngressTelemetry:
             self._set_inflight(self._inflight)
         return time.perf_counter()
 
-    def end(self, t0: float, deployment: str, outcome: str) -> None:
+    def end(self, t0: float, deployment: str, outcome: str,
+            sclass: str = "?", request_id: Optional[str] = None
+            ) -> None:
         with self._lock:
             self._inflight -= 1
             self._set_inflight(self._inflight)
         elapsed = time.perf_counter() - t0
         try:
-            from ..util.metrics import Histogram
+            from ..util.metrics import Counter, Histogram
 
             Histogram("rt_serve_request_seconds",
-                      "HTTP ingress request latency.",
-                      tag_keys=("deployment", "outcome")).observe(
+                      "Ingress request latency.",
+                      tag_keys=("deployment", "outcome",
+                                "status_class")).observe(
                 elapsed,
-                tags={"deployment": deployment, "outcome": outcome})
+                tags={"deployment": deployment, "outcome": outcome,
+                      "status_class": sclass})
+            Counter("rt_serve_requests_total",
+                    "Ingress requests by status class (the "
+                    "availability SLO's error-budget input).",
+                    tag_keys=("deployment", "status_class")).inc(
+                tags={"deployment": deployment,
+                      "status_class": sclass})
         except Exception:
             pass
         try:
             from ..util import spans
 
             wall_end = time.time()
-            spans.record_span(deployment or "?", wall_end - elapsed,
-                              wall_end, cat="serve",
-                              tags={"deployment": deployment,
-                                    "outcome": outcome})
+            tags = {"deployment": deployment, "outcome": outcome,
+                    "status_class": sclass, "proto": self._proto}
+            if request_id:
+                tags["request_id"] = request_id
+            spans.record_span("ingress", wall_end - elapsed,
+                              wall_end, cat="serve", tags=tags)
+        except Exception:
+            pass
+
+    def observe_ttft(self, deployment: str, seconds: float) -> None:
+        """End-to-end ingress-to-first-token (streaming requests)."""
+        try:
+            from ..util.metrics import Histogram
+
+            Histogram("rt_serve_ttft_seconds",
+                      "Ingress-to-first-token latency (streaming "
+                      "requests).",
+                      tag_keys=("deployment",)).observe(
+                seconds, tags={"deployment": deployment})
+        except Exception:
+            pass
+
+    @staticmethod
+    def observe_phase(phase: str, seconds: float) -> None:
+        """One TTFT phase observation (proxy parse/route/dispatch
+        overhead here; admission queue at the handle's gate; engine
+        waiting + prefill inside the generation engine)."""
+        try:
+            from ..util.metrics import observe_ttft_phase
+
+            observe_ttft_phase(phase, seconds)
         except Exception:
             pass
 
@@ -146,10 +211,11 @@ class HTTPProxy:
             except ValueError:
                 return None
 
-        async def _handle(request: "web.Request",
-                          tel: Dict[str, str]) -> "web.Response":
+        async def _handle(request: "web.Request", tel: Dict[str, str],
+                          rid: str) -> "web.Response":
             from .controller import DeploymentHandle
 
+            t_ingress = time.perf_counter()
             path = "/" + request.match_info.get("tail", "")
             target = self._route_table.resolve(path)
             tel["deployment"] = target or "?"
@@ -195,7 +261,10 @@ class HTTPProxy:
                 # is retried on another replica like a unary call, so
                 # the first-frame pull happens BEFORE the 200 goes
                 # out and pre-stream failures get real status codes.
-                it = handle.stream_timed(timeout_s, payload)
+                self._telemetry.observe_phase(
+                    "proxy", time.perf_counter() - t_ingress)
+                it = handle.stream_timed(timeout_s, payload,
+                                         request_id=rid)
                 _END = object()
 
                 def _next():
@@ -234,8 +303,13 @@ class HTTPProxy:
                 except Exception as e:  # noqa: BLE001
                     return web.json_response(
                         {"error": repr(e)}, status=_error_status(e))
+                self._telemetry.observe_ttft(
+                    target, time.perf_counter() - t_ingress)
                 resp = web.StreamResponse()
                 resp.content_type = "application/x-ndjson"
+                # Stream headers flush at prepare(): the id must be on
+                # the response BEFORE the first chunk goes out.
+                resp.headers[REQUEST_ID_HEADER] = rid
                 await resp.prepare(request)
                 step = None
                 try:
@@ -267,9 +341,12 @@ class HTTPProxy:
                             pass
                     raise
                 return resp
+            self._telemetry.observe_phase(
+                "proxy", time.perf_counter() - t_ingress)
             call_fut = loop.run_in_executor(
                 self._executor,
-                lambda: handle.call(payload, timeout_s=timeout_s))
+                lambda: handle.call(payload, timeout_s=timeout_s,
+                                    request_id=rid))
             try:
                 result = await _bounded(call_fut)
             except asyncio.TimeoutError:
@@ -285,16 +362,34 @@ class HTTPProxy:
             return web.json_response({"result": repr(result)})
 
         async def handler(request: "web.Request") -> "web.Response":
+            from ..util import tracing
+
+            # Honor the client's X-RT-Request-Id (sanitized) or mint
+            # one; it is echoed on EVERY response — 2xx, 404, 429,
+            # 504, and the stream's prepare() headers — so a client
+            # holding an error body can hand support the exact id.
+            rid = clean_request_id(
+                request.headers.get(REQUEST_ID_HEADER)) \
+                or tracing.new_request_id()
             t0 = self._telemetry.begin()
             tel = {"deployment": "?"}
-            outcome = "error"
+            outcome, sclass = "error", "5xx"
             try:
-                resp = await _handle(request, tel)
+                resp = await _handle(request, tel, rid)
                 outcome = ("ok" if resp.status < 400
                            else f"http_{resp.status}")
+                sclass = status_class(resp.status)
+                if not resp.prepared:
+                    resp.headers[REQUEST_ID_HEADER] = rid
                 return resp
+            except (ConnectionError, asyncio.CancelledError):
+                # The CLIENT went away: not a server failure — it
+                # must not burn the availability error budget.
+                outcome, sclass = "disconnect", "4xx"
+                raise
             finally:
-                self._telemetry.end(t0, tel["deployment"], outcome)
+                self._telemetry.end(t0, tel["deployment"], outcome,
+                                    sclass, rid)
 
         def run_server():
             loop = asyncio.new_event_loop()

@@ -30,6 +30,12 @@ rendered with an explanation and the suggested next probe —
   open circuits         serve replicas routers black-holed after
                         consecutive system faults (critical when a
                         deployment has EVERY breaker open)
+  SLO burn              deployments burning their error budget too
+                        fast (warning) or with the budget already
+                        spent (critical) — util/slo.py burn rates
+  slow requests         the slowest traced requests in the exemplar
+                        window, named with id, deployment, and the
+                        dominant TTFT phase (``rt trace <id>``)
 
 The check functions are pure (plain dicts in, findings out) so they
 unit-test without a cluster; ``cluster_diagnosis`` wires them to a live
@@ -716,6 +722,106 @@ def find_checkpoint_risk(scans: List[Dict],
     return out
 
 
+def find_slo_burn(slo_report: Optional[Dict], now: float
+                  ) -> List[Dict]:
+    """SLO error-budget findings from an evaluated SLO report
+    (util/slo.py ``evaluate_all`` output): a fast burn rate — the
+    budget would be gone in a fraction of the window — is a WARNING
+    page; a budget already exhausted is CRITICAL (`rt doctor` exits
+    non-zero: the deployment is out of contract until the window
+    rolls).  Slow burns and p99 breaches are informational."""
+    out = []
+    for r in (slo_report or {}).get("objectives") or []:
+        status = r.get("status")
+        if status in (None, "ok", "no_data", "low_traffic"):
+            continue
+        dep, kind = r.get("deployment", "?"), r.get("kind", "?")
+        if status == "exhausted":
+            out.append(_finding(
+                "slo_exhausted", "critical",
+                f"deployment {dep!r} has SPENT its {kind} error "
+                f"budget: {100 * r.get('budget_consumed', 0.0):.0f}% "
+                f"used ({r.get('errors', 0):.0f} errors / "
+                f"{r.get('requests', 0):.0f} requests in the "
+                f"{r.get('window_s', 0):.0f}s window)",
+                detail="every further error is a contract violation "
+                       "until the window rolls over; stop risky "
+                       "rollouts and shed optional traffic.",
+                probe="rt slo; rt trace (slowest exemplars); "
+                      "rt telemetry (serve section)",
+                data=dict(r)))
+        elif status == "fast_burn":
+            out.append(_finding(
+                "slo_fast_burn", "warning",
+                f"deployment {dep!r} is burning its {kind} error "
+                f"budget {r.get('burn_rate', 0.0):.1f}x too fast "
+                f"(error rate "
+                f"{100 * (r.get('error_rate') or 0.0):.2f}%, "
+                f"budget {100 * r.get('budget_consumed', 0.0):.0f}% "
+                f"used)",
+                detail="at this burn rate the whole window's budget "
+                       "is gone in a fraction of the window — page-"
+                       "worthy per the multi-window burn-rate "
+                       "policy.",
+                probe="rt slo; rt trace; rt doctor "
+                      "(open_circuit / crashlooping_replica)",
+                data=dict(r)))
+        elif status in ("slow_burn", "breach"):
+            what = (f"burning budget "
+                    f"{r.get('burn_rate', 0.0):.1f}x too fast"
+                    if status == "slow_burn" else
+                    f"p99 {r.get('observed_p99_ms', 0.0):.1f}ms over "
+                    f"the {r.get('target', 0.0):g}ms target")
+            out.append(_finding(
+                "slo_burn", "info",
+                f"deployment {dep!r} {kind}: {what}",
+                detail="sustained, this consumes the error budget "
+                       "ahead of schedule — ticket-worthy, not "
+                       "page-worthy.",
+                probe="rt slo; rt trace",
+                data=dict(r)))
+    return out
+
+
+def find_slow_requests(exemplars: List[Dict], now: float,
+                       spans: Optional[List[Dict]] = None,
+                       threshold_s: float = 2.0,
+                       max_findings: int = 3) -> List[Dict]:
+    """Name the slowest concrete requests in the exemplar window that
+    exceed ``threshold_s`` — request id, deployment, duration, and
+    (when the span sink still holds the hops) the dominant TTFT
+    phase, so the operator starts at `rt trace <id>` instead of
+    guessing."""
+    from .reqtrace import assemble_trace
+
+    out = []
+    for rec in (exemplars or [])[:max_findings]:
+        dur = float(rec.get("duration_s", 0.0))
+        if dur < threshold_s:
+            continue  # slowest-first: everything after is faster
+        rid = rec.get("request_id", "?")
+        dominant = None
+        if spans:
+            trace = assemble_trace(spans, rid)
+            if trace.get("found"):
+                dominant = trace.get("dominant_phase")
+        out.append(_finding(
+            "slow_request", "warning",
+            f"request {rid} to {rec.get('deployment', '?')!r} took "
+            f"{dur:.2f}s"
+            + (f", dominated by the {dominant} phase"
+               if dominant else ""),
+            detail="one of the slowest requests in the exemplar "
+                   "window; its cross-process hop chain is "
+                   "retrievable while the span sink retains it.",
+            probe=f"rt trace {rid}",
+            data={"request_id": rid, "duration_s": dur,
+                  "deployment": rec.get("deployment"),
+                  "dominant_phase": dominant,
+                  "status_class": rec.get("status_class")}))
+    return out
+
+
 def find_autoscaler_gaps(decisions: List[Dict], now: float,
                          horizon_s: float = 300.0) -> List[Dict]:
     """Recent autoscaler ticks that saw demand no launchable node
@@ -776,7 +882,11 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
              straggler_threshold: float = 0.2,
              starvation_warn_s: float = 60.0,
              checkpoints: Optional[Dict] = None,
-             preemption_grace_s: float = 30.0) -> Dict[str, Any]:
+             preemption_grace_s: float = 30.0,
+             slo: Optional[Dict] = None,
+             exemplars: Optional[List[Dict]] = None,
+             serve_spans: Optional[List[Dict]] = None,
+             slow_request_s: float = 2.0) -> Dict[str, Any]:
     """Pure aggregation of every check over already-fetched state
     (unit-testable without a cluster)."""
     now = time.time() if now is None else now
@@ -803,6 +913,10 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
     findings += find_checkpoint_risk(
         (checkpoints or {}).get("scans") or [],
         (checkpoints or {}).get("save"), preemption_grace_s, now=now)
+    findings += find_slo_burn(slo, now)
+    findings += find_slow_requests(exemplars or [], now,
+                                   spans=serve_spans,
+                                   threshold_s=slow_request_s)
     findings += find_flight_dumps(feed.get("flight") or [], now)
     findings.sort(key=lambda f: _SEV_ORDER.get(f["severity"], 9))
     return {
@@ -889,10 +1003,11 @@ def cluster_diagnosis(*, address: Optional[str] = None,
     except Exception:
         serve = {}
     checkpoints: Dict[str, Any] = {}
+    tel_sources: Optional[Dict[str, List[Dict]]] = None
     try:
         raw = state_api.telemetry(address=address)
-        checkpoints["save"] = _checkpoint_save_stats(
-            raw.get("sources") or {})
+        tel_sources = raw.get("sources") or {}
+        checkpoints["save"] = _checkpoint_save_stats(tel_sources)
     except Exception:
         pass
     if run_dir:
@@ -900,6 +1015,27 @@ def cluster_diagnosis(*, address: Optional[str] = None,
 
         checkpoints["scans"] = [{"run_dir": run_dir,
                                  "entries": scan_run_dir(run_dir)}]
+    try:
+        from . import slo as slo_mod
+
+        # Reuse the telemetry snapshot fetched above — the heaviest
+        # controller RPC must not be paid twice per doctor run.
+        slo_report = slo_mod.report(address=address,
+                                    sources=tel_sources)
+    except Exception:
+        slo_report = None
+    try:
+        exemplars = state_api.request_exemplars(
+            address=address).get("exemplars") or []
+    except Exception:
+        exemplars = []
+    serve_spans: List[Dict] = []
+    if exemplars:
+        try:
+            serve_spans = state_api.list_spans(limit=50000,
+                                               address=address)
+        except Exception:
+            serve_spans = []
     return diagnose(
         feed=feed, tasks=tasks, spans=spans, load=load, pgs=pgs,
         nodes=nodes, ledgers=ledgers, serve=serve,
@@ -913,7 +1049,11 @@ def cluster_diagnosis(*, address: Optional[str] = None,
         straggler_threshold=config.straggler_threshold,
         starvation_warn_s=config.starvation_warn_s,
         checkpoints=checkpoints,
-        preemption_grace_s=config.preemption_grace_s)
+        preemption_grace_s=config.preemption_grace_s,
+        slo=slo_report, exemplars=exemplars,
+        serve_spans=serve_spans,
+        slow_request_s=float(os.environ.get("RT_SLOW_REQUEST_S",
+                                            "2.0")))
 
 
 def render_text(diag: Dict[str, Any]) -> str:

@@ -150,6 +150,26 @@ class Histogram(Metric):
                                for k, h in self._hist.items()]}
 
 
+def ttft_phase_histogram() -> Histogram:
+    """THE time-to-first-token phase histogram — one definition so the
+    proxy, the handle's admission gate, and the generation engine all
+    register the identical (name, tag_keys) pair; drift here would
+    silently split the metric at the telemetry merge."""
+    return Histogram("rt_serve_ttft_phase_seconds",
+                     "Time-to-first-token split by phase.",
+                     tag_keys=("phase",))
+
+
+def observe_ttft_phase(phase: str, seconds: float) -> None:
+    """Record one TTFT phase observation; never raises (observability
+    must not fail the request path)."""
+    try:
+        ttft_phase_histogram().observe(seconds,
+                                       tags={"phase": phase})
+    except Exception:
+        pass
+
+
 def _esc(v: str) -> str:
     """Prometheus exposition label-value escaping."""
     return str(v).replace("\\", "\\\\").replace('"', '\\"') \

@@ -66,6 +66,13 @@ class SpanRing:
             if cur:
                 ev["trace_id"] = cur["trace_id"]
                 ev["parent_span_id"] = cur["span_id"]
+                # Serve request context: every span recorded inside a
+                # request_scope carries the request id, so `rt trace
+                # <id>` can assemble the cross-process hop chain.
+                if cur.get("request_id") and \
+                        "request_id" not in (tags or {}):
+                    tags = dict(tags or {})
+                    tags["request_id"] = cur["request_id"]
             ev["span_id"] = _tracing._new_id()
         else:
             for k in ("trace_id", "span_id", "parent_span_id"):

@@ -212,6 +212,36 @@ def timeline_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
                                             address=address))
 
 
+def request_exemplars(*, address: Optional[str] = None
+                      ) -> Dict[str, Any]:
+    """The controller's slowest-request exemplar ring (slowest-first,
+    bounded per window): {"exemplars": [{request_id, duration_s,
+    deployment, ts, ...}], "window_s"} — the `rt trace` listing and
+    the doctor's find_slow_requests input."""
+    return _call("request_exemplars", {}, address)
+
+
+def request_trace(request_id: str, *, address: Optional[str] = None
+                  ) -> Dict[str, Any]:
+    """Assemble one request's cross-process hop chain (proxy ->
+    admission -> attempt -> replica -> engine) from the span sink —
+    the `rt trace <request_id>` data.  ``request_id`` may be a prefix;
+    ambiguity is reported rather than guessed."""
+    from . import spans as spans_mod
+    from .reqtrace import assemble_trace, find_request_ids
+
+    # Ship this process's own ring first (driver-side spans).
+    spans_mod.flush()
+    spans = list_spans(limit=100000, address=address)
+    ids = find_request_ids(spans, prefix=request_id)
+    if len(ids) > 1 and request_id not in ids:
+        return {"request_id": request_id, "found": False,
+                "ambiguous": ids[:10]}
+    rid = request_id if request_id in ids else (ids[0] if ids
+                                                else request_id)
+    return assemble_trace(spans, rid)
+
+
 def explain_task(task_id: str, *, address: Optional[str] = None
                  ) -> Dict[str, Any]:
     """Scheduler explainability: the full transition chain (queued ->

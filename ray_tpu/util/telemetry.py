@@ -198,8 +198,46 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
             for s in snap.get("series", []):
                 tags = s.get("tags") or {}
                 key = tags.get("deployment", "?")
-                serve.setdefault("requests", {})[key] = _hist_stats(
-                    snap.get("boundaries", []), s.get("hist", {}))
+                # Status-class tagging splits a deployment into
+                # several series — merge them back for the per-
+                # deployment latency row.
+                reqs = serve.setdefault("requests", {})
+                reqs[key] = _merge_hist_stats(
+                    reqs.get(key),
+                    _hist_stats(snap.get("boundaries", []),
+                                s.get("hist", {})))
+        elif name == "rt_serve_requests_total":
+            for s in snap.get("series", []):
+                tags = s.get("tags") or {}
+                dep = tags.get("deployment", "?")
+                cls = tags.get("status_class", "?")
+                row = serve.setdefault("status_classes",
+                                       {}).setdefault(dep, {})
+                row[cls] = row.get(cls, 0.0) + float(
+                    s.get("value", 0.0))
+        elif name == "rt_serve_ttft_seconds":
+            for s in snap.get("series", []):
+                tags = s.get("tags") or {}
+                dep = tags.get("deployment", "?")
+                ttft = serve.setdefault("ttft", {})
+                ttft[dep] = _merge_hist_stats(
+                    ttft.get(dep),
+                    _hist_stats(snap.get("boundaries", []),
+                                s.get("hist", {})))
+        elif name == "rt_serve_ttft_phase_seconds":
+            for s in snap.get("series", []):
+                phase = (s.get("tags") or {}).get("phase", "?")
+                ph = serve.setdefault("ttft_phases", {})
+                ph[phase] = _merge_hist_stats(
+                    ph.get(phase),
+                    _hist_stats(snap.get("boundaries", []),
+                                s.get("hist", {})))
+        elif name == "rt_llm_tpot_seconds":
+            for s in snap.get("series", []):
+                llm["tpot"] = _merge_hist_stats(
+                    llm.get("tpot"),
+                    _hist_stats(snap.get("boundaries", []),
+                                s.get("hist", {})))
         elif name == "rt_serve_inflight":
             for s in snap.get("series", []):
                 serve["inflight"] = serve.get("inflight", 0.0) + float(
@@ -229,6 +267,26 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
     except Exception:
         pass
 
+    # --- SLO plane: declared objectives (RT_SLO_CONFIG) + the default
+    # availability objective, evaluated from the status-class counter
+    # history and the latency/TTFT histograms just fetched.
+    slo_report: Dict[str, Any] = {}
+    try:
+        import time as _time
+
+        from . import slo as slo_mod
+
+        objectives, default = slo_mod.objectives_from_env()
+        slo_report = slo_mod.evaluate_all(
+            objectives, slo_mod.status_series(history),
+            now=float(raw.get("ts") or _time.time()),
+            latency_p99_ms=slo_mod.latency_p99s(sources),
+            ttft_p99_ms=slo_mod.latency_p99s(
+                sources, metric=slo_mod.TTFT_METRIC),
+            default_spec=default)
+    except Exception:
+        pass
+
     # --- per-step time series from the controller's retained history.
     series: Dict[str, List] = {}
     for src, rows in (history or {}).items():
@@ -244,6 +302,7 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
 
     return {
         "ts": raw.get("ts"),
+        "slo": slo_report,
         "goodput": goodput_mod.summarize_sources(sources),
         "train": train,
         "train_series": series,
@@ -338,10 +397,28 @@ def render_text(summary: Dict[str, Any]) -> str:
     if serve.get("requests"):
         lines.append("\nServe ingress:")
         for dep, h in sorted(serve["requests"].items()):
+            cls = (serve.get("status_classes") or {}).get(dep) or {}
+            cls_s = "  ".join(f"{c}={cls[c]:.0f}"
+                              for c in sorted(cls)) if cls else ""
             lines.append(f"  {dep:<20} n={h['count']}  mean "
                          f"{h['mean'] * 1e3:.1f}ms  p99≤"
-                         f"{h['p99'] * 1e3:.1f}ms")
+                         f"{h['p99'] * 1e3:.1f}ms"
+                         + (f"  [{cls_s}]" if cls_s else ""))
         lines.append(f"  in-flight now: {serve.get('inflight', 0):.0f}")
+    if serve.get("ttft") or serve.get("ttft_phases"):
+        lines.append("\nServe TTFT (time to first token):")
+        for dep, h in sorted((serve.get("ttft") or {}).items()):
+            lines.append(f"  {dep:<20} n={h['count']}  p50≤"
+                         f"{h['p50'] * 1e3:.1f}ms  p99≤"
+                         f"{h['p99'] * 1e3:.1f}ms")
+        phases = serve.get("ttft_phases") or {}
+        for phase in ("proxy", "admission_queue", "engine_waiting",
+                      "prefill"):
+            h = phases.get(phase)
+            if h and h["count"]:
+                lines.append(f"    {phase:<17} mean "
+                             f"{h['mean'] * 1e3:.2f}ms  p99≤"
+                             f"{h['p99'] * 1e3:.1f}ms  n={h['count']}")
     if serve.get("retries") or serve.get("shed") or \
             serve.get("deadline_exceeded") or serve.get("resilience"):
         lines.append("\nServe resilience:")
@@ -393,6 +470,12 @@ def render_text(summary: Dict[str, Any]) -> str:
         if llm.get("evictions"):
             lines.append(f"  evictions      {llm['evictions']:.0f} "
                          "(KV-pressure recompute preemptions)")
+        tpot = llm.get("tpot")
+        if isinstance(tpot, dict) and tpot.get("count"):
+            lines.append(f"  TPOT           mean "
+                         f"{tpot['mean'] * 1e3:.2f}ms  p99≤"
+                         f"{tpot['p99'] * 1e3:.1f}ms "
+                         f"(inter-token, n={tpot['count']})")
 
     ck = summary.get("checkpoints") or {}
     if ck.get("bytes") or ck.get("save") or ck.get("restore"):
@@ -435,6 +518,15 @@ def render_text(summary: Dict[str, Any]) -> str:
         lines.append(f"  spilled now   {_fmt_rate(objs['spilled_bytes'])}B")
         lines.append(f"  spills total  {objs['spill_total']:.0f}")
         lines.append(f"  restores      {objs['restore_total']:.0f}")
+
+    slo_rows = (summary.get("slo") or {}).get("objectives") or []
+    if slo_rows:
+        from . import slo as slo_mod
+
+        # Reuse the `rt slo` renderer's rows under a section header.
+        body = slo_mod.render_text(summary["slo"]).splitlines()
+        lines.append("\nSLOs:")
+        lines.extend(body[1:])
 
     flights = summary.get("flight", [])
     if flights:

@@ -43,6 +43,22 @@ def current_span_context() -> Optional[Dict[str, str]]:
     return _current.get()
 
 
+def current_request_id() -> Optional[str]:
+    """The request id of the active serve request context, or None.
+    Request ids are minted at the ingress proxies (or honored from the
+    client's ``X-RT-Request-Id`` header / ``rt-request-id`` gRPC
+    metadata) and ride the span context through handle dispatch into
+    the replica and the generation engine."""
+    ctx = _current.get()
+    return ctx.get("request_id") if ctx else None
+
+
+def new_request_id() -> str:
+    """Mint a request id (16 hex chars — short enough for headers and
+    log lines, unique enough for the exemplar window)."""
+    return _new_id(8)
+
+
 def set_span_context(ctx: Optional[Dict[str, str]]) -> None:
     """Adopt a propagated context (the worker does this around task
     execution, so nested .remote() calls nest under the task's span).
@@ -69,6 +85,8 @@ class start_span:
         }
         if parent:
             self.ctx["parent_span_id"] = parent["span_id"]
+            if parent.get("request_id"):
+                self.ctx["request_id"] = parent["request_id"]
         self._prev = parent
         self._t0 = time.time()
         _current.set(self.ctx)
@@ -93,6 +111,21 @@ def inject(spec) -> None:
     if ctx is not None:
         spec.trace_ctx = {"trace_id": ctx["trace_id"],
                           "parent_span_id": ctx["span_id"]}
+        if ctx.get("request_id"):
+            spec.trace_ctx["request_id"] = ctx["request_id"]
+
+
+def maybe_inject(spec, enabled: bool) -> None:
+    """Inject the span context when cluster tracing is enabled OR —
+    regardless of the flag — when the active context carries a serve
+    request id: request-scoped tracing must follow one request through
+    the replica hop without requiring cluster-wide task tracing to be
+    on.  One contextvar read on the submit hot path when idle."""
+    ctx = _current.get()
+    if ctx is None:
+        return
+    if enabled or ctx.get("request_id"):
+        inject(spec)
 
 
 def child_context(trace_ctx: Optional[Dict[str, str]]
@@ -100,9 +133,51 @@ def child_context(trace_ctx: Optional[Dict[str, str]]
     """Worker-side: the span this task executes AS."""
     if not trace_ctx:
         return None
-    return {"trace_id": trace_ctx["trace_id"],
-            "span_id": _new_id(),
-            "parent_span_id": trace_ctx.get("parent_span_id", "")}
+    out = {"trace_id": trace_ctx["trace_id"],
+           "span_id": _new_id(),
+           "parent_span_id": trace_ctx.get("parent_span_id", "")}
+    if trace_ctx.get("request_id"):
+        out["request_id"] = trace_ctx["request_id"]
+    return out
+
+
+class request_scope:
+    """Context manager establishing a serve request context: the
+    request id (plus a trace id derived from it) becomes the active
+    span context, so ``spans.record_span`` auto-tags every span
+    recorded inside with the request id and ``maybe_inject`` carries
+    it across the actor-task hop into the replica.
+
+    Re-entrant in the nesting sense: entering with the SAME id under
+    an existing scope keeps the parent linkage; entering with a new id
+    starts a fresh trace.  ``rid=None`` keeps any existing context
+    untouched (no-op scope) — callers without an id never pay for one.
+    """
+
+    def __init__(self, rid: Optional[str]):
+        self.rid = rid
+        self._prev: Optional[Dict[str, str]] = None
+        self._set = False
+
+    def __enter__(self) -> "request_scope":
+        if not self.rid:
+            return self
+        parent = _current.get()
+        ctx = {"trace_id": (parent or {}).get("trace_id")
+               or f"req-{self.rid}",
+               "span_id": _new_id(),
+               "request_id": self.rid}
+        if parent:
+            ctx["parent_span_id"] = parent["span_id"]
+        self._prev = parent
+        self._set = True
+        _current.set(ctx)
+        return self
+
+    def __exit__(self, *exc):
+        if self._set:
+            _current.set(self._prev)
+        return False
 
 
 def trace_tree(task_records: List[Dict[str, Any]],

@@ -1,0 +1,304 @@
+"""Request-scoped tracing units (no cluster): trace assembly from a
+synthetic span set, the TTFT phase decomposition summing to the
+end-to-end first-token time, exemplar-ring bounding/eviction, the
+request-context propagation plumbing (request_scope -> span tags ->
+TaskSpec injection), ingress status-class mapping, and the generation
+engine's lifecycle spans on a real tiny engine.
+
+ISSUE 11 (observability tentpole): request tracing & SLO plane.
+"""
+
+import dataclasses
+import time
+
+import pytest
+
+from ray_tpu.util import spans, tracing
+from ray_tpu.util.reqtrace import (ExemplarRing, assemble_trace,
+                                   find_request_ids, render_trace,
+                                   ttft_phases)
+
+RID = "aabbccdd00112233"
+
+
+def _span(name, start, end, cat="serve", rid=RID, **tags):
+    return {"name": name, "cat": cat, "start": start, "end": end,
+            "pid": 1, "source": "test",
+            "tags": {"request_id": rid, **tags}}
+
+
+def _chain(rid=RID):
+    """Synthetic ingress->engine hop chain: first token at t=0.7."""
+    return [
+        _span("ingress", 0.0, 1.0, rid=rid, deployment="llm",
+              outcome="ok", status_class="2xx"),
+        _span("admission_wait", 0.1, 0.3, rid=rid, deployment="llm"),
+        _span("attempt", 0.3, 0.95, rid=rid, deployment="llm",
+              replica="r0", attempt=0, breaker="closed",
+              outcome="first_frame"),
+        _span("replica_exec", 0.35, 0.95, rid=rid, cat="serve",
+              deployment="llm"),
+        _span("engine_waiting", 0.4, 0.6, rid=rid, cat="llm", seq=1),
+        _span("prefill", 0.6, 0.7, rid=rid, cat="llm", seq=1,
+              prompt_tokens=4),
+        _span("decode", 0.7, 0.95, rid=rid, cat="llm", seq=1,
+              tokens=8),
+    ]
+
+
+# ------------------------------------------------------ trace assembly
+def test_assemble_trace_orders_hops_and_names_dominant_phase():
+    # Shuffle input: assembly must sort by (start, hop order).
+    chain = _chain()
+    trace = assemble_trace(list(reversed(chain)), RID)
+    assert trace["found"]
+    assert [h["name"] for h in trace["hops"]] == [
+        "ingress", "admission_wait", "attempt", "replica_exec",
+        "engine_waiting", "prefill", "decode"]
+    assert trace["deployment"] == "llm"
+    assert trace["total_s"] == pytest.approx(1.0)
+    # admission (0.2) and engine_waiting (0.2) tie at the top; the
+    # dominant phase is one of them, never prefill/proxy.
+    assert trace["dominant_phase"] in ("admission_queue",
+                                       "engine_waiting")
+    # Unrelated spans (other request ids, no id) never leak in.
+    noise = [_span("ingress", 5.0, 6.0, rid="ffff000011112222"),
+             {"name": "allreduce", "cat": "collective", "start": 1,
+              "end": 2, "pid": 3}]
+    assert len(assemble_trace(chain + noise, RID)["hops"]) == 7
+
+
+def test_ttft_phases_sum_to_end_to_end_first_token_time():
+    """The decomposition's accounting invariant: proxy + admission +
+    engine_waiting + prefill + other == ingress-start -> first-token,
+    with 'other' holding the unattributed dispatch/serialization
+    residue (never negative)."""
+    phases = ttft_phases(_chain())
+    assert phases["proxy"] == pytest.approx(0.1)       # 0.0 -> 0.1
+    assert phases["admission_queue"] == pytest.approx(0.2)
+    assert phases["engine_waiting"] == pytest.approx(0.2)
+    assert phases["prefill"] == pytest.approx(0.1)
+    assert phases["other"] >= 0.0
+    # First token emits at prefill end (0.7); e2e from ingress start.
+    assert sum(phases.values()) == pytest.approx(0.7)
+
+
+def test_ttft_phases_partial_chain_never_negative():
+    # Engine-only view (spans expired / non-proxy caller): still sums
+    # cleanly from the first known hop.
+    sub = [s for s in _chain() if s["cat"] == "llm"]
+    phases = ttft_phases(sub)
+    assert phases["proxy"] == 0.0 and phases["admission_queue"] == 0.0
+    assert phases["engine_waiting"] == pytest.approx(0.2)
+    assert all(v >= 0.0 for v in phases.values())
+
+
+def test_find_request_ids_and_prefix_match():
+    sp = _chain() + [_span("ingress", 2.0, 2.5, rid="ff00ff00ff00ff00")]
+    assert set(find_request_ids(sp)) == {RID, "ff00ff00ff00ff00"}
+    assert find_request_ids(sp, prefix="aabb") == [RID]
+    assert find_request_ids(sp, prefix="zz") == []
+
+
+def test_render_trace_text():
+    text = render_trace(assemble_trace(_chain(), RID))
+    assert RID in text and "ingress" in text and "prefill" in text
+    assert "ttft breakdown" in text and "dominant phase" in text
+    missing = render_trace(assemble_trace([], "beef"))
+    assert "no spans found" in missing
+
+
+# ------------------------------------------------------- exemplar ring
+def test_exemplar_ring_keeps_slowest_n_bounded():
+    ring = ExemplarRing(capacity=3, window_s=0)   # no window eviction
+    now = 1000.0
+    for i, dur in enumerate([0.5, 0.1, 2.0, 1.0, 0.05, 3.0]):
+        ring.offer(f"r{i}", dur, deployment="d", ts=now)
+    snap = ring.snapshot(now=now)
+    assert len(snap) == 3
+    assert [r["request_id"] for r in snap] == ["r5", "r2", "r3"]
+    # A faster-than-floor offer is rejected outright when full.
+    assert ring.offer("fast", 0.2, ts=now) is False
+    assert len(ring) == 3
+
+
+def test_exemplar_ring_window_eviction():
+    ring = ExemplarRing(capacity=8, window_s=60.0)
+    ring.offer("old", 9.0, ts=100.0)
+    ring.offer("new", 1.0, ts=150.0)
+    assert [r["request_id"] for r in ring.snapshot(now=155.0)] == \
+        ["old", "new"]
+    # The old (slowest!) exemplar ages out of the window; a slower-
+    # than-floor newcomer is admitted again afterwards.
+    assert [r["request_id"] for r in ring.snapshot(now=161.0)] == \
+        ["new"]
+    assert ring.offer("late", 0.5, ts=162.0) is True
+
+
+# -------------------------------------------- context propagation
+def test_request_scope_sets_and_restores_context():
+    assert tracing.current_request_id() is None
+    with tracing.request_scope("req1"):
+        assert tracing.current_request_id() == "req1"
+        # Nested spans inherit the request id.
+        with tracing.start_span("inner"):
+            assert tracing.current_request_id() == "req1"
+    assert tracing.current_request_id() is None
+    # None scope is a no-op (no context minted for untraced traffic).
+    with tracing.request_scope(None):
+        assert tracing.current_request_id() is None
+
+
+def test_record_span_auto_tags_request_id():
+    ring = spans.reset()
+    with tracing.request_scope("req2"):
+        spans.record_span("hop", 1.0, 2.0, cat="serve",
+                          tags={"deployment": "d"})
+    spans.record_span("plain", 1.0, 2.0)
+    recs = {r["name"]: r for r in ring.drain()}
+    assert recs["hop"]["tags"]["request_id"] == "req2"
+    assert "request_id" not in (recs["plain"].get("tags") or {})
+
+
+class _Spec:
+    trace_ctx = None
+
+
+def test_maybe_inject_carries_request_id_without_tracing_flag():
+    spec = _Spec()
+    tracing.maybe_inject(spec, enabled=False)
+    assert spec.trace_ctx is None          # no context, no injection
+    with tracing.request_scope("req3"):
+        spec = _Spec()
+        tracing.maybe_inject(spec, enabled=False)
+        assert spec.trace_ctx["request_id"] == "req3"
+        child = tracing.child_context(spec.trace_ctx)
+        assert child["request_id"] == "req3"
+    # Plain span context without a request id stays flag-gated.
+    with tracing.start_span("s"):
+        spec = _Spec()
+        tracing.maybe_inject(spec, enabled=False)
+        assert spec.trace_ctx is None
+        tracing.maybe_inject(spec, enabled=True)
+        assert spec.trace_ctx is not None
+        assert "request_id" not in spec.trace_ctx
+
+
+# --------------------------------------------------- ingress mapping
+def test_status_class_mapping():
+    from ray_tpu.serve.proxy import status_class
+
+    assert status_class(200) == "2xx"
+    assert status_class(404) == "4xx"
+    assert status_class(429) == "shed"
+    assert status_class(504) == "deadline"
+    assert status_class(500) == "5xx"
+    assert status_class(503) == "5xx"
+
+
+def test_clean_request_id_sanitizes_hostile_headers():
+    from ray_tpu.serve.proxy import clean_request_id
+
+    assert clean_request_id("abc-123_X.y:z") == "abc-123_X.y:z"
+    assert clean_request_id("a b\nc\"<script>") == "abcscript"
+    assert clean_request_id("x" * 200) == "x" * 64
+    assert clean_request_id("") is None
+    assert clean_request_id("\n\t ") is None
+    assert clean_request_id(None) is None
+
+
+# --------------------------------------------- engine lifecycle spans
+@pytest.fixture(scope="module")
+def engine():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.llm.engine import EngineConfig, GenerationEngine
+    from ray_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+    cfg = dataclasses.replace(GPT2Config.tiny(), remat=False,
+                              dtype=jnp.float32)
+    eng = GenerationEngine(
+        model_cfg=cfg,
+        engine_cfg=EngineConfig(page_size=4, num_pages=64,
+                                max_batch=4,
+                                prefill_token_budget=64,
+                                max_tokens_default=8),
+        params=gpt2_init(cfg, jax.random.PRNGKey(0))).start()
+    yield eng
+    eng.stop()
+
+
+def test_engine_emits_lifecycle_spans_for_traced_request(engine):
+    ring = spans.reset()
+    toks = engine.generate([3, 1, 4, 1], max_tokens=6,
+                           request_id="req-abc")
+    assert len(toks) == 6
+    recs = [r for r in ring.snapshot()
+            if (r.get("tags") or {}).get("request_id") == "req-abc"]
+    by_name = {r["name"]: r for r in recs}
+    assert {"engine_waiting", "prefill", "decode"} <= set(by_name)
+    assert all(r["cat"] == "llm" for r in recs)
+    # Phase ordering: waiting ends where prefill starts; decode spans
+    # first token -> last token and names the token count.
+    assert by_name["engine_waiting"]["end"] <= \
+        by_name["prefill"]["start"] + 1e-6
+    assert by_name["prefill"]["end"] <= by_name["decode"]["start"] \
+        + 1e-6
+    assert by_name["decode"]["tags"]["tokens"] == 6
+    # The assembled trace attributes the TTFT to engine phases.
+    trace = assemble_trace(recs, "req-abc")
+    assert trace["found"] and trace["phases"]["prefill"] > 0.0
+    # Engine-side accounting moved with it.
+    st = engine.stats()
+    assert st["ttft_requests"] >= 1
+    assert st["ttft_prefill_s_total"] > 0.0
+    assert st["tpot_count"] >= 5           # 6 tokens -> 5 gaps
+
+
+def test_engine_untraced_request_records_no_spans(engine):
+    ring = spans.reset()
+    engine.generate([9, 9], max_tokens=3)
+    assert not [r for r in ring.snapshot() if r.get("cat") == "llm"]
+
+
+def test_engine_warmup_excluded_from_ttft_and_tpot_accounting():
+    """The warmup sequence pays the prefill/decode COMPILES — its
+    multi-second samples must not enter the phase/TPOT accounting
+    real traffic is judged by."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.llm.engine import EngineConfig, GenerationEngine
+    from ray_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+    cfg = dataclasses.replace(GPT2Config.tiny(), remat=False,
+                              dtype=jnp.float32)
+    eng = GenerationEngine(
+        model_cfg=cfg,
+        engine_cfg=EngineConfig(page_size=4, num_pages=64,
+                                max_batch=4,
+                                prefill_token_budget=64,
+                                max_tokens_default=8),
+        params=gpt2_init(cfg, jax.random.PRNGKey(1)))
+    try:
+        eng.start()
+        eng.warmup()
+        st = eng.stats()
+        assert st["ttft_requests"] == 0
+        assert st["ttft_prefill_s_total"] == 0.0
+        assert st["tpot_count"] == 0
+        # Real traffic accounts normally afterwards.
+        eng.generate([1, 2, 3], max_tokens=4)
+        st = eng.stats()
+        assert st["ttft_requests"] == 1 and st["tpot_count"] >= 3
+    finally:
+        eng.stop()
+
+
+def test_engine_generate_accepts_request_id_kwarg(engine):
+    # generate() must forward request_id through submit.
+    seq = engine.submit([2, 7], max_tokens=2, request_id="req-zz")
+    frames = list(engine.frames(seq))
+    assert frames[-1].get("done")
+    assert seq.request_id == "req-zz"

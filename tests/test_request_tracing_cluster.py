@@ -1,0 +1,243 @@
+"""Acceptance (ISSUE 11): on a TWO-NODE cluster, one traced LLM
+request over the HTTP ingress yields a cross-process hop chain
+(proxy -> replica -> engine) retrievable via `rt trace <id>`, with
+TTFT phase spans present and the request id echoed in the response
+header; a synthetic error burst drives `rt doctor` to a critical SLO
+finding that clears after recovery.  Slow: replicas import jax and
+compile the tiny engine."""
+
+import contextlib
+import dataclasses
+import io
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import state as state_api
+
+pytestmark = pytest.mark.slow
+
+_ENV = {"RT_METRICS_REPORT_PERIOD_S": "0.3"}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    old = {k: os.environ.get(k) for k in _ENV}
+    os.environ.update(_ENV)
+    c = Cluster(head_node_args={"num_cpus": 3})
+    c.add_node(num_cpus=3)
+    ray_tpu.init(address=c.address)
+    c.wait_for_nodes()
+    yield c
+    from ray_tpu import serve
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+    c.shutdown()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _tiny_cfg():
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt2 import GPT2Config
+
+    return dataclasses.replace(GPT2Config.tiny(), remat=False,
+                               dtype=jnp.float32, max_seq=128)
+
+
+@pytest.fixture(scope="module")
+def http_port(cluster):
+    from ray_tpu import serve
+    from ray_tpu.llm import EngineConfig, llm_deployment
+
+    app = llm_deployment(
+        name="llm", model="gpt2", model_cfg=_tiny_cfg(),
+        engine_cfg=EngineConfig(page_size=8, num_pages=32,
+                                max_batch=4, max_tokens_default=8),
+        num_cpus=1, seed=0)
+    handle = serve.run(app, route_prefix="/llm")
+    # First stream waits out replica init (jax import + compiles).
+    assert list(handle.stream({"prompt": [1, 2], "max_tokens": 2}))
+    return serve.start_http_proxy()
+
+
+def _post(port, path, payload, headers=None, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _cli(args):
+    from ray_tpu.scripts import cli as cli_mod
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_mod.main(args)
+    return rc, buf.getvalue()
+
+
+def test_traced_request_end_to_end(cluster, http_port):
+    addr = cluster.address
+    rid = "acceptreq" + os.urandom(4).hex()
+    deadline = time.time() + 60
+    while True:
+        try:
+            with _post(http_port, "/llm",
+                       {"prompt": [5, 9, 101], "max_tokens": 5},
+                       headers={"X-RT-Request-Id": rid}) as resp:
+                # The id is echoed on the streaming 200.
+                assert resp.headers.get("X-RT-Request-Id") == rid
+                lines = [json.loads(ln) for ln in
+                         resp.read().decode().strip().splitlines()]
+            break
+        except urllib.error.HTTPError as e:
+            if e.code != 404 or time.time() > deadline:
+                raise   # 404 = route push still propagating
+            time.sleep(0.5)
+    assert sum(1 for ln in lines if "token" in ln) == 5
+    assert lines[-1].get("done")
+
+    # The hop chain assembles from the controller span sink once the
+    # proxy/replica flush loops tick.
+    deadline = time.time() + 60
+    trace = {}
+    while time.time() < deadline:
+        trace = state_api.request_trace(rid, address=addr)
+        names = {h["name"] for h in trace.get("hops", [])}
+        if {"ingress", "replica_exec", "engine_waiting",
+                "prefill"} <= names:
+            break
+        time.sleep(0.5)
+    names = {h["name"] for h in trace.get("hops", [])}
+    assert {"ingress", "attempt", "replica_exec", "engine_waiting",
+            "prefill", "decode"} <= names, trace
+    # Cross-process: proxy and replica hops come from different pids.
+    pids = {h.get("pid") for h in trace["hops"]}
+    assert len(pids) >= 2, trace["hops"]
+    # TTFT phase decomposition is present and consistent.
+    assert trace["phases"]["prefill"] > 0.0
+    assert trace["phases"]["engine_waiting"] >= 0.0
+    assert trace["deployment"] == "llm"
+    att = next(h for h in trace["hops"] if h["name"] == "attempt")
+    assert att["tags"].get("breaker") == "closed"
+    assert att["tags"].get("replica")
+
+    # `rt trace <id>` renders the chain (prefix match too).
+    rc, out = _cli(["trace", rid, "--address", addr])
+    assert rc == 0, out
+    for hop in ("ingress", "replica_exec", "prefill"):
+        assert hop in out
+    assert "dominant phase" in out
+    rc, out = _cli(["trace", rid[:9], "--address", addr])
+    assert rc == 0 and "ingress" in out
+
+    # The ingress span fed the exemplar listing.
+    rc, out = _cli(["trace", "--address", addr])
+    assert rc == 0 and rid in out
+
+
+def test_request_id_echoed_on_error_responses(cluster, http_port):
+    # 404 (no route) still carries the id the client sent.
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{http_port}/nosuchroute",
+        data=b"{}", headers={"X-RT-Request-Id": "errid12345"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 404
+    assert ei.value.headers.get("X-RT-Request-Id") == "errid12345"
+    # And a minted one comes back when the client sends none.
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{http_port}/nosuchroute", data=b"{}")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.headers.get("X-RT-Request-Id")
+
+
+def test_error_burst_drives_doctor_slo_critical_then_clears(
+        cluster, http_port):
+    from ray_tpu import serve
+
+    addr = cluster.address
+
+    class Flaky:
+        def __call__(self, payload):
+            if (payload or {}).get("fail"):
+                raise RuntimeError("synthetic burst failure")
+            return {"ok": True}
+
+    handle = serve.run(
+        serve.deployment(Flaky, name="flaky", num_replicas=1,
+                         ray_actor_options={"num_cpus": 0.5}).bind(),
+        name="flaky-app", route_prefix="/flaky")
+    handle.call({"fail": False})   # warm the route
+
+    def burst(n, fail):
+        errors = 0
+        for _ in range(n):
+            try:
+                with _post(http_port, "/flaky", {"fail": fail},
+                           timeout=60) as resp:
+                    assert resp.status == 200
+            except urllib.error.HTTPError as e:
+                assert e.code == 500
+                errors += 1
+        return errors
+
+    # Generous target (50%) and a short window so recovery can
+    # outvote the burst within the test's runtime.
+    os.environ["RT_SLO_CONFIG"] = \
+        '{"flaky": {"availability": 0.5, "window_s": 120}}'
+    try:
+        deadline = time.time() + 60
+        while True:
+            try:
+                assert burst(20, fail=True) == 20
+                break
+            except urllib.error.URLError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.5)   # route push still propagating
+
+        # All-error traffic: the budget is spent -> CRITICAL finding
+        # and a non-zero doctor exit.
+        deadline = time.time() + 60
+        found = False
+        while time.time() < deadline and not found:
+            rc, out = _cli(["doctor", "--address", addr])
+            found = "slo_exhausted" in out and "flaky" in out
+            if found:
+                assert rc == 1, out
+            else:
+                time.sleep(1.0)
+        assert found, out
+
+        # Recovery: enough successes to push the window's error share
+        # back under the (generous) budget -> the finding clears.
+        assert burst(60, fail=False) == 0
+        deadline = time.time() + 90
+        cleared = False
+        while time.time() < deadline and not cleared:
+            rc, out = _cli(["doctor", "--address", addr])
+            cleared = "slo_exhausted" not in out \
+                and "slo_fast_burn" not in out
+            if not cleared:
+                time.sleep(2.0)
+        assert cleared, out
+        rc, out = _cli(["slo", "--address", addr])
+        assert "flaky" in out, out
+    finally:
+        os.environ.pop("RT_SLO_CONFIG", None)

@@ -1,0 +1,349 @@
+"""SLO / error-budget plane units (no cluster, no jax): objective
+parsing, windowed counter deltas (incl. counter resets), burn-rate
+math, the multi-window status ladder (ok -> slow_burn -> fast_burn ->
+exhausted), latency/TTFT p99 objectives, history-key parsing, the
+doctor's find_slo_burn severity transitions, and find_slow_requests.
+
+ISSUE 11 (observability tentpole): request tracing & SLO plane.
+"""
+
+from typing import Optional
+
+import pytest
+
+from ray_tpu.util import slo
+from ray_tpu.util.doctor import find_slo_burn, find_slow_requests
+from ray_tpu.util.slo import (Objective, burn_rate, error_rate,
+                              evaluate_all, evaluate_objective,
+                              objectives_from_env, parse_objectives,
+                              status_series, window_counts)
+
+NOW = 100_000.0
+
+
+def _series(rate: float, *, window_s: float = 4000.0,
+            burst_s: Optional[float] = None, base_rate: float = 0.0,
+            burst_end_s: float = 0.0,
+            per_sample: int = 10, step_s: float = 10.0):
+    """Cumulative status-class samples: errors at ``rate`` during the
+    burst (the last ``burst_s`` seconds, ending ``burst_end_s`` ago;
+    default: the whole span), ``base_rate`` otherwise."""
+    out = []
+    good = bad = 0.0
+    t = NOW - window_s
+    while t <= NOW:
+        out.append((t, {"2xx": good, "5xx": bad}))
+        # The increment at sample t covers [t, t+step): strict end.
+        in_burst = burst_s is None or (
+            NOW - burst_s - burst_end_s <= t < NOW - burst_end_s)
+        r = rate if in_burst else base_rate
+        bad += per_sample * r
+        good += per_sample * (1.0 - r)
+        t += step_s
+    return out
+
+
+# ------------------------------------------------------------ parsing
+def test_parse_objectives_and_validation():
+    objs = parse_objectives({
+        "llm": {"availability": 0.999, "ttft_p99_ms": 100,
+                "latency_p99_ms": 500, "window_s": 600},
+        "api": {"availability": 0.99}})
+    kinds = {(o.deployment, o.kind): o for o in objs}
+    assert kinds[("llm", "availability")].target == 0.999
+    assert kinds[("llm", "availability")].window_s == 600
+    assert kinds[("llm", "availability")].budget == pytest.approx(
+        0.001)
+    assert kinds[("llm", "ttft_p99_ms")].target == 100
+    assert len(objs) == 4
+    with pytest.raises(ValueError):
+        parse_objectives({"llm": {"availabilty": 0.99}})  # typo
+    with pytest.raises(ValueError):
+        parse_objectives({"llm": {"availability": 2.0}})
+    with pytest.raises(ValueError):
+        parse_objectives({"llm": 0.99})
+
+
+def test_objectives_from_env():
+    objs, default = objectives_from_env(env={})
+    assert objs == [] and default == {"availability": 0.99}
+    objs, default = objectives_from_env(env={
+        "RT_SLO_CONFIG": '{"llm": {"availability": 0.999},'
+                         ' "default": {"availability": 0.95}}'})
+    assert [o.deployment for o in objs] == ["llm"]
+    assert default == {"availability": 0.95}
+
+
+# ------------------------------------------------------- window math
+def test_window_counts_uses_pre_window_baseline():
+    samples = [(0.0, {"2xx": 0.0}), (50.0, {"2xx": 100.0}),
+               (100.0, {"2xx": 150.0, "5xx": 5.0})]
+    # Window [40, 100]: baseline is the sample at t=0? No — newest
+    # at-or-before 40 is t=0 (value 0): delta 150 good + 5 bad.
+    assert window_counts(samples, 100.0, 60.0) == {"2xx": 150.0,
+                                                   "5xx": 5.0}
+    # Window [50, 100]: baseline t=50 -> only the last delta.
+    assert window_counts(samples, 100.0, 50.0) == {"2xx": 50.0,
+                                                   "5xx": 5.0}
+    assert window_counts([], 100.0, 60.0) == {}
+    assert window_counts(samples[:1], 100.0, 60.0) == {}
+
+
+def test_window_counts_clamps_counter_resets():
+    samples = [(0.0, {"2xx": 500.0}), (50.0, {"2xx": 520.0}),
+               (60.0, {"2xx": 10.0}),    # proxy restarted
+               (90.0, {"2xx": 40.0})]
+    # 20 before the reset + 30 after; the reset step contributes 0,
+    # never a negative delta.
+    assert window_counts(samples, 100.0, 100.0) == {"2xx": 50.0}
+
+
+def test_error_rate_and_burn_rate():
+    assert error_rate({}) is None
+    assert error_rate({"2xx": 100.0}) == 0.0
+    assert error_rate({"2xx": 90.0, "5xx": 5.0, "shed": 3.0,
+                       "deadline": 2.0}) == pytest.approx(0.1)
+    # 4xx counts as served (client error), not budget burn.
+    assert error_rate({"2xx": 50.0, "4xx": 50.0}) == 0.0
+    assert burn_rate(None, 0.01) == 0.0
+    assert burn_rate(0.05, 0.01) == pytest.approx(5.0)
+
+
+# ------------------------------------------------- status transitions
+def _avail(target=0.99, window_s=3600.0):
+    return Objective("llm", "availability", target, window_s)
+
+
+def test_evaluate_no_data_and_ok():
+    row = evaluate_objective(_avail(), [], NOW)
+    assert row["status"] == "no_data"
+    row = evaluate_objective(_avail(), _series(0.001), NOW)
+    assert row["status"] == "ok"
+    assert row["burn_rate"] == pytest.approx(0.1, rel=0.2)
+
+
+def test_evaluate_slow_then_fast_burn():
+    # Budget 1%, 3600s window (alert windows: long 60s, short 30s).
+    # 5% errors for the last 300s: burn 5x on both alert windows
+    # (slow); the burst spends only ~40% of the budget.
+    row = evaluate_objective(
+        _avail(), _series(0.05, burst_s=300.0), NOW)
+    assert row["status"] == "slow_burn"
+    assert 3.0 <= row["burn_rate"] <= 6.0
+    assert row["budget_consumed"] < 1.0
+    # 20% errors for the last 100s: burn 20x on both (fast/page),
+    # budget ~55% used — caught while there is still budget to save.
+    row = evaluate_objective(
+        _avail(), _series(0.20, burst_s=100.0), NOW)
+    assert row["status"] == "fast_burn"
+    assert row["burn_rate"] >= 14.4
+    assert row["burn_rate_short"] >= 14.4
+    assert row["budget_consumed"] < 1.0
+
+
+def test_fast_burn_requires_both_windows():
+    """An error burst that already stopped must NOT page: the short
+    window is clean even though the long window still burns hot."""
+    # 50% errors in [NOW-60, NOW-30]; the short window is clean.
+    row = evaluate_objective(
+        _avail(), _series(0.50, burst_s=30.0, burst_end_s=30.0), NOW)
+    assert row["burn_rate"] >= 14.4          # long window still hot
+    assert row["burn_rate_short"] == 0.0     # burst over
+    assert row["status"] == "ok"
+
+
+def test_low_traffic_never_pages():
+    """One error on a near-idle deployment must NOT read as an
+    exhausted budget: below min_requests the objective reports
+    low_traffic, which find_slo_burn ignores."""
+    samples = [(NOW - 300.0, {"2xx": 0.0, "5xx": 0.0}),
+               (NOW - 10.0, {"2xx": 4.0, "5xx": 1.0})]
+    row = evaluate_objective(_avail(), samples, NOW)
+    assert row["requests"] == 5.0 and row["errors"] == 1.0
+    assert row["status"] == "low_traffic"
+    assert find_slo_burn({"objectives": [row]}, NOW) == []
+    # Enough traffic: the same error share is judged normally.
+    samples = [(NOW - 300.0, {"2xx": 0.0, "5xx": 0.0}),
+               (NOW - 10.0, {"2xx": 40.0, "5xx": 10.0})]
+    row = evaluate_objective(_avail(), samples, NOW)
+    assert row["status"] == "exhausted"
+    # The effective window is reported (history shorter than 3600s).
+    assert row["window_effective_s"] == pytest.approx(300.0)
+
+
+def test_evaluate_exhausted_budget_is_terminal():
+    # 2% sustained errors over the FULL window vs a 1% budget: the
+    # budget is spent even though the instantaneous burn is mild.
+    row = evaluate_objective(_avail(window_s=3000.0),
+                             _series(0.02, window_s=3200.0), NOW)
+    assert row["status"] == "exhausted"
+    assert row["budget_consumed"] >= 1.0
+    assert row["errors"] > 0
+
+
+def test_latency_and_ttft_objectives():
+    lat = Objective("llm", "latency_p99_ms", 500.0)
+    assert evaluate_objective(lat, [], NOW)["status"] == "no_data"
+    assert evaluate_objective(lat, [], NOW,
+                              latency_p99_ms=400.0)["status"] == "ok"
+    row = evaluate_objective(lat, [], NOW, latency_p99_ms=800.0)
+    assert row["status"] == "breach"
+    assert row["observed_p99_ms"] == 800.0
+    ttft = Objective("llm", "ttft_p99_ms", 100.0)
+    assert evaluate_objective(
+        ttft, [], NOW, ttft_p99_ms=150.0)["status"] == "breach"
+
+
+def test_evaluate_all_skips_unroutable_pseudo_deployment():
+    """Requests that failed before route resolution land in the "?"
+    bucket; the default objective must NOT fan out to it (an
+    unactionable CRITICAL naming deployment '?')."""
+    rep = evaluate_all([], {"?": _series(1.0, burst_s=100.0)}, NOW,
+                       default_spec={"availability": 0.99})
+    assert rep["objectives"] == []
+    # An EXPLICIT "?" objective would still evaluate (operator's say).
+    rep = evaluate_all([Objective("?", "availability", 0.99)],
+                       {"?": _series(0.0)}, NOW)
+    assert len(rep["objectives"]) == 1
+
+
+def test_evaluate_all_applies_default_and_sorts_worst_first():
+    rep = evaluate_all(
+        [Objective("llm", "availability", 0.99)],
+        {"llm": _series(0.20, burst_s=100.0),
+         "other": _series(0.0)},
+        NOW, default_spec={"availability": 0.99})
+    by_dep = {(r["deployment"], r["kind"]): r
+              for r in rep["objectives"]}
+    assert by_dep[("llm", "availability")]["status"] == "fast_burn"
+    # "other" got the default objective without being declared.
+    assert by_dep[("other", "availability")]["status"] == "ok"
+    assert rep["worst"] == "fast_burn"
+    assert rep["objectives"][0]["deployment"] == "llm"
+
+
+def test_status_series_parses_flattened_history_keys():
+    history = {
+        "proxy-1": [
+            [10.0, {"rt_serve_requests_total{deployment=llm,"
+                    "status_class=2xx}": 5.0,
+                    "rt_serve_inflight": 1.0}],
+            [20.0, {"rt_serve_requests_total{deployment=llm,"
+                    "status_class=2xx}": 9.0,
+                    "rt_serve_requests_total{deployment=llm,"
+                    "status_class=5xx}": 1.0}],
+        ],
+        "proxy-2": [
+            [20.0, {"rt_serve_requests_total{deployment=llm,"
+                    "status_class=2xx}": 3.0}],
+        ],
+    }
+    series = status_series(history)
+    assert set(series) == {"llm"}
+    assert series["llm"] == [
+        (10.0, {"2xx": 5.0}),
+        (20.0, {"2xx": 12.0, "5xx": 1.0})]   # sources sum per bucket
+
+
+def test_status_series_multi_source_carry_forward_stays_monotone():
+    """Two proxies reporting the same deployment at interleaved
+    timestamps must merge into ONE monotone cumulative series (naive
+    interleave would read every source switch as a counter reset and
+    zero the deltas)."""
+    key = "rt_serve_requests_total{deployment=llm,status_class=2xx}"
+    history = {
+        "proxy-1": [[10.0, {key: 100.0}], [20.0, {key: 110.0}]],
+        "proxy-2": [[15.0, {key: 5.0}]],
+    }
+    series = status_series(history)["llm"]
+    assert series == [(10.0, {"2xx": 100.0}),
+                      (15.0, {"2xx": 105.0}),
+                      (20.0, {"2xx": 115.0})]
+    # Deltas over the whole span: 10 (p1) + 5 (p2), no fake reset.
+    assert window_counts(series, 25.0, 20.0) == {"2xx": 15.0}
+
+
+def test_render_text_mentions_status_and_targets():
+    rep = evaluate_all([_avail()],
+                       {"llm": _series(0.20, burst_s=100.0)}, NOW)
+    text = slo.render_text(rep)
+    assert "FAST_BURN" in text and "llm" in text and "99%" in text
+    assert "burn" in text
+    assert "no SLO objectives" in slo.render_text(
+        {"objectives": []})
+
+
+# ----------------------------------------------------- doctor wiring
+def _report_with(status, **extra):
+    return {"objectives": [{"deployment": "llm",
+                            "kind": "availability", "target": 0.99,
+                            "window_s": 3600.0, "status": status,
+                            "error_rate": 0.2, "burn_rate": 20.0,
+                            "burn_rate_short": 20.0,
+                            "budget_consumed": 0.4, "errors": 80.0,
+                            "requests": 400.0, **extra}]}
+
+
+def test_find_slo_burn_severity_transitions():
+    assert find_slo_burn(None, NOW) == []
+    assert find_slo_burn(_report_with("ok"), NOW) == []
+    assert find_slo_burn(_report_with("no_data"), NOW) == []
+    info = find_slo_burn(_report_with("slow_burn"), NOW)
+    assert [f["severity"] for f in info] == ["info"]
+    warn = find_slo_burn(_report_with("fast_burn"), NOW)
+    assert [f["severity"] for f in warn] == ["warning"]
+    assert warn[0]["check"] == "slo_fast_burn"
+    assert "llm" in warn[0]["summary"]
+    crit = find_slo_burn(
+        _report_with("exhausted", budget_consumed=1.3), NOW)
+    assert [f["severity"] for f in crit] == ["critical"]
+    assert crit[0]["check"] == "slo_exhausted"
+    breach = find_slo_burn(
+        _report_with("breach", kind="ttft_p99_ms",
+                     observed_p99_ms=150.0, target=100.0), NOW)
+    assert [f["severity"] for f in breach] == ["info"]
+
+
+def test_find_slow_requests_names_id_and_dominant_phase():
+    exemplars = [
+        {"request_id": "slowreq1", "duration_s": 5.0,
+         "deployment": "llm", "ts": NOW, "status_class": "2xx"},
+        {"request_id": "fastreq", "duration_s": 0.1,
+         "deployment": "llm", "ts": NOW},
+    ]
+    spans = [
+        {"name": "ingress", "cat": "serve", "start": 0.0, "end": 5.0,
+         "tags": {"request_id": "slowreq1", "deployment": "llm"}},
+        {"name": "admission_wait", "cat": "serve", "start": 0.1,
+         "end": 4.5, "tags": {"request_id": "slowreq1"}},
+        {"name": "prefill", "cat": "llm", "start": 4.6, "end": 4.9,
+         "tags": {"request_id": "slowreq1"}},
+    ]
+    out = find_slow_requests(exemplars, NOW, spans=spans,
+                             threshold_s=2.0)
+    assert len(out) == 1
+    f = out[0]
+    assert f["severity"] == "warning"
+    assert "slowreq1" in f["summary"]
+    assert "admission_queue" in f["summary"]
+    assert "rt trace slowreq1" in f["probe"]
+    # Below threshold: nothing fires.
+    assert find_slow_requests(exemplars, NOW, threshold_s=10.0) == []
+    assert find_slow_requests([], NOW) == []
+
+
+def test_diagnose_carries_slo_and_exemplar_findings():
+    from ray_tpu.util.doctor import diagnose
+
+    diag = diagnose(
+        feed={}, tasks=[], spans=[], load={}, pgs=[], nodes=[],
+        ledgers=[], now=NOW,
+        slo=_report_with("exhausted"),
+        exemplars=[{"request_id": "r1", "duration_s": 9.0,
+                    "deployment": "llm", "ts": NOW}],
+        slow_request_s=2.0)
+    checks = {f["check"] for f in diag["findings"]}
+    assert {"slo_exhausted", "slow_request"} <= checks
+    assert not diag["healthy"]
+    # Criticals sort first (the CLI's exit-1 signal).
+    assert diag["findings"][0]["severity"] == "critical"

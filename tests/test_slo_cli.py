@@ -1,0 +1,280 @@
+"""`rt slo` / `rt trace` CLI plane: the jax/aiohttp-free import guard
+(an ops box without the ML deps must be able to evaluate SLOs and
+assemble traces), plus the CLI + /api routes against a live local
+cluster — driver-recorded request spans flow into the controller span
+sink, feed the exemplar ring, and come back out through `rt trace`.
+
+Mirrors tests/test_timeline_cli.py (ISSUE 2's guard pattern) for the
+ISSUE 11 surfaces.
+"""
+
+import contextlib
+import io
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -------------------------------------------------- import guard
+def test_slo_and_trace_plane_import_without_jax_or_aiohttp():
+    """util/slo.py, util/reqtrace.py, the state API, and the trace/slo
+    CLI paths must import AND compute on a box with neither jax nor
+    aiohttp installed — `rt slo` / `rt trace` are ops-box tools."""
+    script = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+
+        class _Block:
+            BLOCKED = ("jax", "aiohttp", "flax", "optax")
+            def find_module(self, name, path=None):
+                root = name.split(".")[0]
+                return self if root in self.BLOCKED else None
+            def load_module(self, name):
+                raise ImportError(f"blocked import: {{name}}")
+
+        sys.meta_path.insert(0, _Block())
+        for mod in ("jax", "aiohttp"):
+            assert mod not in sys.modules
+
+        from ray_tpu.util import slo, reqtrace
+        from ray_tpu.util import state  # noqa: F401
+        from ray_tpu.scripts import cli
+
+        # The parser knows the new subcommands (no lazy jax import).
+        parser = cli._build_parser()
+        for args in (["slo"], ["trace"], ["trace", "abc123"]):
+            ns = parser.parse_args(args)
+            assert callable(ns.fn)
+
+        # Trace assembly + rendering over a synthetic span set.
+        spans = [
+            {{"name": "ingress", "cat": "serve", "start": 0.0,
+              "end": 1.0, "pid": 1,
+              "tags": {{"request_id": "rid1",
+                        "deployment": "llm"}}}},
+            {{"name": "prefill", "cat": "llm", "start": 0.4,
+              "end": 0.6, "pid": 2,
+              "tags": {{"request_id": "rid1"}}}},
+        ]
+        trace = reqtrace.assemble_trace(spans, "rid1")
+        assert trace["found"] and trace["dominant_phase"]
+        text = reqtrace.render_trace(trace)
+        assert "rid1" in text
+
+        # SLO evaluation end to end (parse -> windows -> render).
+        objs = slo.parse_objectives(
+            {{"llm": {{"availability": 0.999}}}})
+        rep = slo.evaluate_all(
+            objs, {{"llm": [(0.0, {{"2xx": 0.0}}),
+                            (50.0, {{"2xx": 100.0, "5xx": 1.0}})]}},
+            now=60.0)
+        assert rep["objectives"][0]["status"] in (
+            "ok", "slow_burn", "fast_burn", "exhausted")
+        assert "llm" in slo.render_text(rep)
+
+        ring = reqtrace.ExemplarRing(capacity=2)
+        ring.offer("a", 1.0); ring.offer("b", 2.0); ring.offer("c", 3.0)
+        assert len(ring) == 2
+        print("GUARD_OK")
+    """)
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=120)
+    assert "GUARD_OK" in out.stdout, out.stderr + out.stdout
+
+
+# --------------------------------------------- CLI against a cluster
+@pytest.fixture(scope="module")
+def rt():
+    import ray_tpu
+
+    handle = ray_tpu.init(mode="cluster", num_cpus=2,
+                          config={"metrics_report_period_s": 0.3})
+    yield handle
+    ray_tpu.shutdown()
+
+
+def _cli(args):
+    from ray_tpu.scripts import cli as cli_mod
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_mod.main(args)
+    return rc, buf.getvalue()
+
+
+def test_trace_and_slo_cli_empty_cluster(rt):
+    addr = rt.controller_addr
+    rc, out = _cli(["slo", "--address", addr])
+    assert rc == 0
+    assert "no SLO objectives" in out or "SLOs" in out
+    rc, out = _cli(["trace", "--address", addr])
+    assert rc == 0 and "no request exemplars" in out
+    rc, out = _cli(["trace", "deadbeef00", "--address", addr])
+    assert rc == 1
+
+
+def test_trace_cli_roundtrip_through_controller_sink(rt):
+    """Driver-recorded request spans -> controller span sink ->
+    exemplar ring -> `rt trace` listing and per-id hop chain."""
+    from ray_tpu.util import spans, state, tracing
+
+    addr = rt.controller_addr
+    rid = tracing.new_request_id()
+    base = time.time() - 5.0
+    spans.record_span("ingress", base, base + 3.0, cat="serve",
+                      tags={"request_id": rid, "deployment": "llm",
+                            "outcome": "ok", "status_class": "2xx"})
+    spans.record_span("admission_wait", base + 0.1, base + 0.4,
+                      cat="serve",
+                      tags={"request_id": rid, "deployment": "llm"})
+    spans.record_span("prefill", base + 1.0, base + 2.0, cat="llm",
+                      tags={"request_id": rid, "seq": 1})
+    assert spans.flush()
+
+    # The ingress span fed the exemplar ring.
+    deadline = time.time() + 20
+    rows = []
+    while time.time() < deadline:
+        rows = state.request_exemplars(
+            address=addr).get("exemplars") or []
+        if any(r["request_id"] == rid for r in rows):
+            break
+        time.sleep(0.2)
+    assert any(r["request_id"] == rid and r["deployment"] == "llm"
+               for r in rows), rows
+
+    rc, out = _cli(["trace", "--address", addr])
+    assert rc == 0 and rid in out
+
+    # Full id and prefix both resolve to the hop chain.
+    for query in (rid, rid[:6]):
+        rc, out = _cli(["trace", query, "--address", addr])
+        assert rc == 0, out
+        assert "ingress" in out and "prefill" in out
+        assert "dominant phase" in out
+    rc, out = _cli(["trace", rid, "--format", "json",
+                    "--address", addr])
+    data = json.loads(out)
+    assert data["found"] and len(data["hops"]) == 3
+    assert data["phases"]["admission_queue"] == pytest.approx(
+        0.3, abs=0.01)
+
+    # The slow request surfaces in rt doctor (3s > the 2s threshold).
+    from ray_tpu.util import doctor as doctor_mod
+
+    diag = doctor_mod.cluster_diagnosis(address=addr)
+    assert any(f["check"] == "slow_request"
+               and rid in f["summary"]
+               for f in diag["findings"]), diag["findings"]
+
+
+def test_slo_cli_with_declared_objectives_and_traffic(rt):
+    """Status-class counters flowing through metrics history drive a
+    declared availability objective; `rt slo` renders and exits by
+    worst status."""
+    import ray_tpu
+
+    addr = rt.controller_addr
+
+    @ray_tpu.remote
+    class Emitter:
+        """Counters must tick inside a WORKER: workers report their
+        metric registry on the flush cadence; the driver does not."""
+
+        def emit(self, n: int) -> bool:
+            from ray_tpu.util.metrics import Counter
+
+            c = Counter("rt_serve_requests_total",
+                        "Ingress requests by status class.",
+                        tag_keys=("deployment", "status_class"))
+            for _ in range(n):
+                c.inc(tags={"deployment": "llm",
+                            "status_class": "5xx"})
+            return True
+
+    em = Emitter.remote()
+    # 100% errors: unambiguous exhausted/fast_burn once two history
+    # samples exist (report period is 0.3s in this fixture).
+    for _ in range(4):
+        assert ray_tpu.get(em.emit.remote(20), timeout=60)
+        time.sleep(0.5)
+
+    os.environ["RT_SLO_CONFIG"] = \
+        '{"llm": {"availability": 0.99, "window_s": 600}}'
+    try:
+        deadline = time.time() + 30
+        rc, out = 0, ""
+        while time.time() < deadline:
+            rc, out = _cli(["slo", "--address", addr])
+            if "llm" in out and ("EXHAUSTED" in out
+                                 or "FAST_BURN" in out):
+                break
+            time.sleep(0.5)
+        assert "llm" in out, out
+        assert "EXHAUSTED" in out or "FAST_BURN" in out, out
+        assert rc == 1   # worst status is page/critical-worthy
+
+        rc, out = _cli(["slo", "--format", "json", "--address", addr])
+        rows = json.loads(out)["objectives"]
+        assert any(r["deployment"] == "llm"
+                   and r["kind"] == "availability" for r in rows)
+
+        # The doctor carries the SLO finding (exhausted => critical
+        # exit), naming the deployment.
+        from ray_tpu.scripts import cli as cli_mod
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            drc = cli_mod.main(["doctor", "--address", addr])
+        text = buf.getvalue()
+        assert "slo_" in text and "llm" in text
+        if "slo_exhausted" in text:
+            assert drc == 1
+    finally:
+        os.environ.pop("RT_SLO_CONFIG", None)
+
+
+def test_dashboard_slo_and_trace_routes(rt):
+    """/api/slo and /api/trace serve the same data as the CLI."""
+    import asyncio
+    import urllib.request
+
+    from aiohttp import web
+
+    from ray_tpu.dashboard import create_app
+
+    async def serve_once():
+        app = create_app()
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        loop = asyncio.get_event_loop()
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}",
+                    timeout=30) as resp:
+                return resp.read().decode()
+
+        slo_raw = await loop.run_in_executor(None, fetch, "/api/slo")
+        trace_raw = await loop.run_in_executor(
+            None, fetch, "/api/trace")
+        one = await loop.run_in_executor(
+            None, fetch, "/api/trace?id=nosuchrequest")
+        await runner.cleanup()
+        return slo_raw, trace_raw, one
+
+    slo_raw, trace_raw, one = asyncio.new_event_loop(
+    ).run_until_complete(serve_once())
+    assert "objectives" in json.loads(slo_raw)
+    assert "exemplars" in json.loads(trace_raw)
+    assert json.loads(one)["found"] is False
