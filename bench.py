@@ -568,6 +568,126 @@ def serve_llm() -> None:
          "unit": "ms", "higher_is_better": False}])
 
 
+def fsdp() -> None:
+    """--fsdp: GPT-2 sharded train steps over a 2-process CPU mesh.
+
+    The multi-host training plane's standing bench: two member
+    processes (each with 2 virtual CPU devices) rendezvous through
+    jax.distributed, lay the 4 devices out as a process-contiguous
+    fsdp x tensor gang mesh (train.distributed), shard the TrainState
+    by the GPT-2 partition rules, and run jit-with-shardings train
+    steps whose gradient reductions cross the process boundary (gloo).
+    Records ``train_fsdp_tokens_per_sec`` (global tokens through the
+    sharded step) and the sharded-step MFU row into PERF.jsonl — the
+    row that catches a regression in the GSPMD path itself (extra
+    resharding copies, lost donation) that the single-chip headline
+    bench can't see."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    addr = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--fsdp-member",
+         str(rank), addr], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for rank in range(2)]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for rank, (p, o) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"fsdp bench member {rank} failed:\n{o[-3000:]}")
+    member = None
+    for line in outs[0].splitlines():
+        if line.startswith("FSDP-MEMBER-0 "):
+            member = json.loads(line.split(" ", 1)[1])
+    if member is None:
+        raise RuntimeError(
+            f"fsdp bench member 0 printed no result:\n{outs[0][-3000:]}")
+    out = {
+        "metric": "train_fsdp_tokens_per_sec",
+        "value": round(member["tokens_per_sec"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,  # CPU mesh: MFU vs 35% is not meaningful
+        "mesh": member["mesh"],
+        "world": 2,
+        "compile_s": round(member["compile_s"], 2),
+        "mfu": member["mfu"],
+    }
+    print(json.dumps(out))
+    _maybe_record(out, extra_rows=[
+        {"benchmark": "train_fsdp_mfu", "value": member["mfu"],
+         "unit": "fraction", "higher_is_better": True}])
+
+
+def _fsdp_member(rank: int, addr: str) -> None:
+    """One rank of the --fsdp bench (spawned by ``fsdp`` above)."""
+    import os
+    import time as _time
+
+    import numpy as np
+
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=2, process_id=rank)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ray_tpu.models.gpt2 import (GPT2Config, gpt2_init,
+                                     gpt2_loss_fn)
+    from ray_tpu.parallel.mesh import gang_mesh
+    from ray_tpu.parallel.partition_rules import tree_shardings
+    from ray_tpu.train import distributed as dist
+    from ray_tpu.train.train_step import (TrainState, make_optimizer,
+                                          make_sharded_train_step)
+
+    cfg = GPT2Config(vocab_size=2048, n_layer=4, n_head=8, d_model=256,
+                     d_ff=1024, max_seq=256, remat=True)
+    params = gpt2_init(cfg, jax.random.PRNGKey(0))
+    optimizer = make_optimizer(total_steps=1000)
+    state = TrainState.create(params, optimizer)
+    shape = dist.derive_mesh_shape(2, jax.local_device_count())
+    mesh = gang_mesh(shape)
+    state, specs = dist.shard_train_state(
+        state, mesh, dist.rules_for_model("gpt2"))
+    shardings = tree_shardings(mesh, specs)
+    step = make_sharded_train_step(
+        lambda p, b: gpt2_loss_fn(cfg, p, b, loss_chunk=0), optimizer,
+        mesh=mesh, state_shardings=shardings,
+        batch_sharding=NamedSharding(mesh, PartitionSpec("fsdp")),
+        telemetry=False)
+    gbs, steps = 8, 6
+    rng = np.random.default_rng(0)
+    full = rng.integers(0, cfg.vocab_size,
+                        (gbs, cfg.max_seq + 1)).astype(np.int32)
+    lo, hi = dist.global_batch_slice(gbs, shape, rank, 2)
+    batch = dist.put_global_batch({"tokens": full[lo:hi]}, mesh,
+                                  global_batch_size=gbs)
+    t0 = _time.perf_counter()
+    state, metrics = step(state, batch)
+    _ = dist.metrics_to_host(metrics)
+    compile_s = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    for _i in range(steps):
+        state, metrics = step(state, batch)
+    _ = dist.metrics_to_host(metrics)  # sync the async dispatch tail
+    elapsed = _time.perf_counter() - t0
+    tok_s = gbs * cfg.max_seq * steps / elapsed
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = _PEAK_FLOPS.get(gen, _PEAK_FLOPS["v5e"]) * len(jax.devices())
+    mfu = tok_s * cfg.flops_per_token() / peak
+    if rank == 0:
+        print("FSDP-MEMBER-0 " + json.dumps(
+            {"tokens_per_sec": tok_s, "compile_s": compile_s,
+             "mesh": shape, "mfu": round(mfu, 6),
+             "loss": dist.metrics_to_host(metrics)["loss"]}),
+            flush=True)
+
+
 def _maybe_record(out: dict, extra_rows: list = None,
                   higher_is_better: bool = True) -> None:
     """--record: append to the PERF.jsonl round-over-round regression
@@ -597,5 +717,10 @@ if __name__ == "__main__":
         cold_start()
     elif "--serve-llm" in sys.argv:
         serve_llm()
+    elif "--fsdp-member" in sys.argv:
+        i = sys.argv.index("--fsdp-member")
+        _fsdp_member(int(sys.argv[i + 1]), sys.argv[i + 2])
+    elif "--fsdp" in sys.argv:
+        fsdp()
     else:
         main()

@@ -46,30 +46,51 @@ def _ensure_jax_world(store, group_name: str, world_size: int,
     if world_size == 1:
         _initialized_world = (1, 0)
         return
+    # Multi-process CPU worlds (the CI backend) need the CPU client
+    # created WITH a cross-process collectives implementation, or every
+    # computation spanning processes fails with "Multiprocess
+    # computations aren't implemented on the CPU backend".  gloo is
+    # compiled into jaxlib; the flag only affects CPU client creation,
+    # so it is harmless on TPU.  Must happen before the first backend
+    # touch — the client is built lazily on first jax.devices().
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older jaxlib without the flag: CPU stays single-process
     key = f"col/{group_name}/coordinator"
-    if rank == 0:
-        import socket
+    # Entry-stamped as gang op #0 (the regular collectives start at
+    # seq 1): while a rank sits inside the rendezvous — waiting for
+    # the coordinator address, or blocked in jax.distributed.initialize
+    # on peers that never arrived — the worker flush loop ships the
+    # stamp, and `rt doctor`'s find_distributed_init_stall names the
+    # missing ranks once RT_DIST_INIT_TIMEOUT_S passes.
+    with _telemetry.timed_op("distributed_init", "xla", world_size,
+                             group_name=group_name, rank=rank,
+                             seq=0):
+        if rank == 0:
+            import socket
 
-        from ray_tpu.core.net import get_node_ip_address
+            from ray_tpu.core.net import get_node_ip_address
 
-        s = socket.socket()
-        s.bind(("", 0))
-        port = s.getsockname()[1]
-        s.close()
-        coord = f"{get_node_ip_address()}:{port}"
-        store.set(key, coord)
-    else:
-        deadline = time.time() + 120
-        while True:
-            coord = store.get(key)
-            if coord:
-                break
-            if time.time() > deadline:
-                raise TimeoutError("coordinator address never appeared")
-            time.sleep(0.02)
-    jax.distributed.initialize(coordinator_address=coord,
-                               num_processes=world_size,
-                               process_id=rank)
+            s = socket.socket()
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+            s.close()
+            coord = f"{get_node_ip_address()}:{port}"
+            store.set(key, coord)
+        else:
+            deadline = time.time() + 120
+            while True:
+                coord = store.get(key)
+                if coord:
+                    break
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        "coordinator address never appeared")
+                time.sleep(0.02)
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=world_size,
+                                   process_id=rank)
     _initialized_world = (world_size, rank)
 
 

@@ -208,6 +208,13 @@ define_flag("collective_watchdog_s", float, 30.0,
             "but others have not joined within this window is flagged "
             "hung by `rt doctor` (names the op and the missing "
             "ranks).")
+define_flag("dist_init_timeout_s", float, 120.0,
+            "Distributed-init watchdog deadline: a gang where some "
+            "ranks entered the jax.distributed mesh rendezvous but "
+            "the barrier has not closed within this window gets an "
+            "`rt doctor` finding naming the missing ranks.  Longer "
+            "than the collective watchdog because a cold rendezvous "
+            "legitimately waits on worker scheduling.")
 define_flag("stuck_task_min_s", float, 60.0,
             "Stuck-task detector floor: a RUNNING task is never "
             "flagged before this age, and a task stuck in owner-side "

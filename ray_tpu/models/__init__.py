@@ -11,3 +11,12 @@ from .gpt2 import (GPT2, GPT2Config, gpt2_loss_fn,  # noqa: F401
                    gpt2_param_axes, gpt2_partition_rules)
 from .llama import (Llama, LlamaConfig, llama_loss_fn,  # noqa: F401
                     llama_param_axes, llama_partition_rules)
+
+# Model-family name -> partition-rule-set factory: the registry the
+# multi-host training plane (train.distributed.rules_for_model), bench
+# and CLI surfaces resolve rule sets through.  Keys are normalized
+# lowercase-no-separator ("gpt2", "llama").
+PARTITION_RULE_SETS = {
+    "gpt2": gpt2_partition_rules,
+    "llama": llama_partition_rules,
+}

@@ -99,3 +99,48 @@ def local_mesh(spec: Optional[MeshSpec] = None):
     if spec is None:
         spec = MeshSpec(data=len(devices))
     return create_mesh(spec, devices)
+
+
+def process_contiguous_devices() -> List:
+    """Global devices in process-major order (all of process 0, then
+    process 1, ...).  jax.devices() is already sorted this way, but
+    the multi-host training plane's slice math DEPENDS on it, so the
+    ordering is enforced here rather than assumed."""
+    import jax
+
+    return sorted(jax.devices(),
+                  key=lambda d: (d.process_index, d.id))
+
+
+def gang_mesh(axis_sizes: Dict[str, int],
+              devices: Optional[Sequence] = None):
+    """Process-contiguous mesh over a gang: a plain C-order reshape of
+    the process-major device list into the named ``axis_sizes``
+    (insertion order = slowest..fastest varying).
+
+    Deliberately NOT ``mesh_utils.create_device_mesh``: its topology
+    optimization may permute devices, and the multi-host training
+    plane needs rank r's devices to occupy a CONTIGUOUS block of
+    flattened mesh coordinates — the invariant that makes per-rank
+    global-batch slices and the sharded checkpoint plane's
+    ``coords_for_rank`` agree with the mesh.  On real TPU slices,
+    process-major C-order already lands the fastest (rightmost) axis
+    on intra-host ICI, which is what the default fsdp x tensor policy
+    wants."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = process_contiguous_devices()
+    devices = list(devices)
+    names = tuple(axis_sizes)
+    shape = tuple(int(axis_sizes[a]) for a in names)
+    n = 1
+    for s in shape:
+        n *= s
+    if n != len(devices):
+        raise ValueError(
+            f"mesh {dict(axis_sizes)} needs {n} devices, gang has "
+            f"{len(devices)}")
+    return Mesh(np.array(devices, dtype=object).reshape(shape), names)

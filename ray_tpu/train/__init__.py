@@ -8,6 +8,10 @@ on the cluster runtime.
 
 from .train_step import (TrainState, make_optimizer,  # noqa: F401
                          make_sharded_train_step, make_train_step)
+from .distributed import (DistributedMesh, derive_mesh_shape,  # noqa
+                          global_batch_slice, mesh_coords_for_rank,
+                          put_global_batch, rules_for_model,
+                          setup_distributed_mesh, shard_train_state)
 from .checkpoint import Checkpoint, CheckpointManager  # noqa: F401
 from .config import (CheckpointConfig, FailureConfig, Result,  # noqa
                      RunConfig, ScalingConfig, TelemetryConfig)

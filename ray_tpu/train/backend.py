@@ -36,7 +36,8 @@ class JaxBackend(Backend):
             # The gang IS an XLA collective group: jax.distributed
             # bootstrap (coordinator rendezvous through the controller
             # KV) lives in one place — the collective library — and
-            # training code can later grab the group's global_mesh().
+            # training code can later grab the group's global_mesh()
+            # or build a gang mesh via train.distributed.
             from ray_tpu import collective as col
 
             if col.is_group_initialized(group_name):
@@ -45,7 +46,11 @@ class JaxBackend(Backend):
                 g = col.init_collective_group(world, rank,
                                               backend="xla",
                                               group_name=group_name)
-            return len(g.devices)
+            import jax
+
+            return {"devices": len(g.devices),
+                    "local_devices": jax.local_device_count(),
+                    "process_count": jax.process_count()}
 
         group_name = f"train/{run_id}"
         refs = []
@@ -56,7 +61,27 @@ class JaxBackend(Backend):
             refs.append(w.actor.run.remote(payload,
                                            (w.rank, num, group_name),
                                            {}))
-        ray_tpu.get(refs, timeout=300)
+        views = ray_tpu.get(refs, timeout=300)
+        # Every rank must see the SAME global world or the gang mesh
+        # (and every collective under it) is built on sand — a rank
+        # that attached to a stale jax.distributed world fails here
+        # with a nameable cause instead of hanging in its first psum.
+        base = views[0]
+        for rank, v in enumerate(views[1:], start=1):
+            if v != base:
+                raise RuntimeError(
+                    f"inconsistent jax world across the gang: rank 0 "
+                    f"sees {base}, rank {rank} sees {v}")
+        if base["process_count"] != num:
+            raise RuntimeError(
+                f"jax.distributed world has {base['process_count']} "
+                f"processes but the gang has {num} workers")
+        from ..util import flight_recorder
+
+        flight_recorder.record("jax_world_up", group=group_name,
+                               world=num,
+                               devices=base["devices"],
+                               devices_per_host=base["local_devices"])
 
     def on_shutdown(self, worker_group) -> None:
         def _teardown():

@@ -20,6 +20,12 @@ class ScalingConfig:
     use_tpu: bool = False
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
+    # Environment applied to every gang worker BEFORE the backend
+    # bootstrap hook runs (i.e. before the worker's first jax import)
+    # — the supported way to set process-level XLA knobs like
+    # XLA_FLAGS=--xla_force_host_platform_device_count=N for the CPU
+    # multi-process CI mesh, or libtpu tuning flags in production.
+    worker_env: Optional[Dict[str, str]] = None
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker:

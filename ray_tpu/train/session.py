@@ -161,11 +161,14 @@ class TrainSession:
             pass  # telemetry must never fail a training step
 
     def iter_device_batches(self, batches, *, depth: int = 2,
-                            transfer=None):
+                            transfer=None, sharding=None,
+                            global_batch_size=None):
         """Device-prefetching wrapper for this worker's step loop; see
         the module-level ``iter_device_batches``."""
         return iter_device_batches(batches, depth=depth,
-                                   transfer=transfer)
+                                   transfer=transfer,
+                                   sharding=sharding,
+                                   global_batch_size=global_batch_size)
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.checkpoint
@@ -353,7 +356,8 @@ def data_wait():
         yield
 
 
-def iter_device_batches(batches, *, depth: int = 2, transfer=None):
+def iter_device_batches(batches, *, depth: int = 2, transfer=None,
+                        sharding=None, global_batch_size=None):
     """Overlap host->device transfer with compute: a feeder thread runs
     ``jax.device_put`` on batch N+1 (N+2, ... up to ``depth``) while
     the step loop computes on batch N, so the loop dequeues
@@ -367,7 +371,12 @@ def iter_device_batches(batches, *, depth: int = 2, transfer=None):
     ``rt_train_data_wait_seconds`` histogram, so the goodput summary
     shows exactly how far from zero-stall the input pipeline runs.
 
-    ``transfer`` overrides the per-batch device placement (e.g.
+    ``sharding`` targets a ``NamedSharding``: each prefetched batch
+    lands as a global array sharded along the mesh's data axis with NO
+    host-side gather — in a multi-process world each rank contributes
+    only the rows it loaded (pass ``global_batch_size`` when the
+    global row count cannot be inferred, e.g. batch replicated over
+    some processes).  ``transfer`` overrides placement entirely (e.g.
     ``lambda b: jax.device_put(b, sharding)``); the default is a plain
     ``jax.device_put`` onto the worker's default device.  Works with
     any iterable of pytrees (dict-of-ndarray batches included).
@@ -376,6 +385,11 @@ def iter_device_batches(batches, *, depth: int = 2, transfer=None):
     """
     from ..util.prefetch import iter_prefetched
 
+    if transfer is None and sharding is not None:
+        from .distributed import batch_transfer
+
+        transfer = batch_transfer(sharding,
+                                  global_batch_size=global_batch_size)
     if transfer is None:
         import jax
 

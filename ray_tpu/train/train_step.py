@@ -82,9 +82,19 @@ def shard_state(state: TrainState, mesh, param_axes_fn, rules=None
 
 
 def make_sharded_train_step(loss_fn, optimizer, mesh=None,
-                            donate: bool = True, telemetry: bool = True):
+                            donate: bool = True, telemetry: bool = True,
+                            state_shardings=None,
+                            batch_sharding=None):
     """Jit the step; with a mesh, shardings propagate from the state
     placement (GSPMD), so no explicit in_shardings are needed.
+
+    The multi-process path (train.distributed) passes the rule-derived
+    ``state_shardings`` (and optionally a ``batch_sharding``)
+    explicitly: jit then PINS the input/output state layout instead of
+    inferring it, so the donated input buffer and the returned state
+    provably share a layout (no resharding copy per step) and the step
+    metrics come back fully replicated — the form every rank can read
+    with ``float()`` and feed the goodput/MFU telemetry below.
 
     With ``telemetry`` (default), each call is timed host-side and
     attributed to the goodput ledger: the first invocation (trace +
@@ -95,7 +105,25 @@ def make_sharded_train_step(loss_fn, optimizer, mesh=None,
     truth is the report-cadence ``rt_train_step_time_seconds``.
     """
     step = make_train_step(loss_fn, optimizer)
-    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    jit_kwargs: Dict[str, Any] = {}
+    if state_shardings is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if batch_sharding is not None:
+            jit_kwargs["in_shardings"] = (state_shardings,
+                                          batch_sharding)
+        out_mesh = mesh
+        if out_mesh is None:
+            leaves = jax.tree_util.tree_leaves(state_shardings)
+            out_mesh = leaves[0].mesh if leaves else None
+        if out_mesh is not None:
+            # One replicated sharding is a tree prefix for the whole
+            # metrics dict.
+            jit_kwargs["out_shardings"] = (
+                state_shardings,
+                NamedSharding(out_mesh, PartitionSpec()))
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else (),
+                     **jit_kwargs)
     if not telemetry:
         return jitted
 

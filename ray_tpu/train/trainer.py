@@ -120,6 +120,11 @@ class BaseTrainer:
             name=f"train_results_{run_id}", num_cpus=0).remote()
         backend = self.backend_cls()
         try:
+            if sc.worker_env:
+                # Before the backend hook: jax reads XLA_FLAGS and
+                # friends at first import, which happens inside
+                # on_start's bootstrap.
+                group.set_env(dict(sc.worker_env))
             backend.on_start(group, run_id)
             local_infos = group.local_ranks()
             # Shard datasets across ranks where supported.

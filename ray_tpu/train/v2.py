@@ -305,14 +305,19 @@ class JaxTrainerV2:
                  train_loop_config: Optional[Dict] = None,
                  scaling_policy: Optional[ScalingPolicy] = None,
                  failure_policy: Optional[FailurePolicy] = None,
-                 run_config=None, datasets=None,
+                 run_config=None, datasets=None, scaling_config=None,
                  resume_from_checkpoint=None, backend_cls=JaxBackend):
         from .config import ScalingConfig
 
+        # num_workers is decided per attempt by the scaling policy;
+        # the rest of the ScalingConfig (worker_env, resource shape,
+        # placement) carries through every attempt via dataclasses
+        # .replace in the controller.
         trainer = BaseTrainer(
             train_loop_per_worker,
             train_loop_config=train_loop_config,
-            scaling_config=ScalingConfig(num_workers=1),
+            scaling_config=scaling_config or ScalingConfig(
+                num_workers=1),
             run_config=run_config, datasets=datasets,
             resume_from_checkpoint=resume_from_checkpoint)
         trainer.backend_cls = backend_cls

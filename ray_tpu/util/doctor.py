@@ -73,6 +73,12 @@ def find_hung_collectives(inflight: List[Dict], now: float,
     session per rank to recover)."""
     out = []
     for rec in inflight or []:
+        if rec.get("op") == "distributed_init":
+            # The mesh rendezvous has its own (longer) deadline and
+            # check — find_distributed_init_stall — because a cold
+            # init legitimately outlives the collective watchdog
+            # while ranks are still being scheduled.
+            continue
         ranks = rec.get("ranks") or {}
         world = int(rec.get("world", 0))
         if not ranks or world <= 0:
@@ -120,6 +126,76 @@ def find_hung_collectives(inflight: List[Dict], now: float,
                 probe="rt timeline --cluster; /api/stack on a member "
                       "worker",
                 data={"op": op, "group": group, "seq": seq,
+                      "age_s": age_all}))
+    return out
+
+
+# ------------------------------------------- distributed-init stall
+def find_distributed_init_stall(inflight: List[Dict], now: float,
+                                deadline_s: float) -> List[Dict]:
+    """Flag gangs stuck in the jax.distributed mesh rendezvous: some
+    ranks stamped entry into ``distributed_init`` (gang op #0, see
+    xla_group._ensure_jax_world) but the barrier has not closed past
+    ``RT_DIST_INIT_TIMEOUT_S`` — the finding names the MISSING ranks
+    (never scheduled, crashed before the rendezvous, or partitioned
+    from the coordinator), the exact triage a stalled init previously
+    cost a per-rank log-reading session."""
+    out = []
+    for rec in inflight or []:
+        if rec.get("op") != "distributed_init":
+            continue
+        ranks = rec.get("ranks") or {}
+        world = int(rec.get("world", 0))
+        if not ranks or world <= 0:
+            continue
+        group = rec.get("group", "?")
+        entered = sorted(int(r) for r in ranks)
+        missing = sorted(set(range(world)) - set(entered))
+        if missing:
+            age = now - min(ranks.values())
+            if age <= deadline_s:
+                continue
+            out.append(_finding(
+                "distributed_init_stall", "critical",
+                f"mesh rendezvous for group {group!r} is stalled: "
+                f"rank(s) {missing} never entered "
+                f"({len(entered)}/{world} waiting {age:.1f}s)",
+                detail=f"ranks {entered} entered jax.distributed "
+                       f"init up to {age:.1f}s ago and are blocked "
+                       f"on the barrier; the missing ranks were "
+                       f"never scheduled, died before the "
+                       f"rendezvous, or cannot reach the "
+                       f"coordinator.",
+                probe="rt ps (are the gang's workers RUNNING?); rt "
+                      "logs (a missing rank's worker); rt doctor "
+                      "--json | jq .findings",
+                data={"group": group, "missing_ranks": missing,
+                      "entered_ranks": entered, "world": world,
+                      "age_s": age}))
+        else:
+            # Every rank is inside yet the barrier hasn't closed —
+            # measured from the LAST entrant (before that, waiting is
+            # entry skew, not a stall): suspect the coordinator
+            # address (firewalled port, wrong interface) rather than
+            # a missing rank.
+            age_all = now - max(ranks.values())
+            if age_all <= deadline_s:
+                continue
+            out.append(_finding(
+                "distributed_init_stall", "critical",
+                f"mesh rendezvous for group {group!r} has all "
+                f"{world} rank(s) inside for {age_all:.1f}s without "
+                f"closing",
+                detail="every rank entered jax.distributed init but "
+                       "the barrier never completed — suspect the "
+                       "coordinator address is unreachable from some "
+                       "hosts (firewall, wrong interface) or the "
+                       "coordinator process wedged.",
+                probe="rt logs (rank 0's worker); check connectivity "
+                      "to the coordinator host:port published in the "
+                      "controller KV",
+                data={"group": group, "missing_ranks": [],
+                      "entered_ranks": entered, "world": world,
                       "age_s": age_all}))
     return out
 
@@ -877,6 +953,7 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
              ledgers: List[Dict], serve: Optional[Dict] = None,
              now: Optional[float] = None,
              collective_watchdog_s: float = 30.0,
+             dist_init_timeout_s: float = 120.0,
              stuck_task_min_s: float = 60.0,
              stuck_task_p99_factor: float = 3.0,
              straggler_threshold: float = 0.2,
@@ -896,6 +973,9 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
     findings += find_hung_collectives(
         feed.get("collective_inflight") or [], now,
         collective_watchdog_s)
+    findings += find_distributed_init_stall(
+        feed.get("collective_inflight") or [], now,
+        dist_init_timeout_s)
     findings += find_draining_nodes(nodes, now)
     findings += find_crashlooping_replicas(serve or {}, now)
     findings += find_open_circuits(serve or {}, now)
@@ -1044,6 +1124,7 @@ def cluster_diagnosis(*, address: Optional[str] = None,
         # dashboard host running this function may be skewed.
         now=feed.get("ts"),
         collective_watchdog_s=config.collective_watchdog_s,
+        dist_init_timeout_s=config.dist_init_timeout_s,
         stuck_task_min_s=config.stuck_task_min_s,
         stuck_task_p99_factor=config.stuck_task_p99_factor,
         straggler_threshold=config.straggler_threshold,

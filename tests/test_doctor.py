@@ -50,6 +50,75 @@ def test_all_ranks_inside_flags_slow_not_hung():
     assert [f["check"] for f in found] == ["slow_collective"]
 
 
+# ------------------------------------------- distributed-init watchdog
+def test_distributed_init_stall_names_missing_ranks():
+    inflight = [{"group": "train/abc", "seq": 0,
+                 "op": "distributed_init", "backend": "xla",
+                 "world": 4,
+                 "ranks": {0: NOW - 200.0, 1: NOW - 199.0}}]
+    found = doctor.find_distributed_init_stall(inflight, NOW,
+                                               deadline_s=120.0)
+    assert len(found) == 1
+    f = found[0]
+    assert f["check"] == "distributed_init_stall"
+    assert f["severity"] == "critical"
+    assert f["data"]["missing_ranks"] == [2, 3]
+    assert f["data"]["entered_ranks"] == [0, 1]
+    assert "train/abc" in f["summary"]
+    assert "[2, 3]" in f["summary"]
+
+
+def test_distributed_init_within_deadline_not_flagged():
+    inflight = [{"group": "g", "seq": 0, "op": "distributed_init",
+                 "backend": "xla", "world": 2,
+                 "ranks": {0: NOW - 30.0}}]
+    assert doctor.find_distributed_init_stall(inflight, NOW,
+                                              120.0) == []
+
+
+def test_distributed_init_all_inside_measures_from_last_entrant():
+    # Entry skew is not a stall: rank 1 entered recently, so the
+    # barrier has only been "closable" for 10s — under the deadline.
+    inflight = [{"group": "g", "seq": 0, "op": "distributed_init",
+                 "backend": "xla", "world": 2,
+                 "ranks": {0: NOW - 500.0, 1: NOW - 10.0}}]
+    assert doctor.find_distributed_init_stall(inflight, NOW,
+                                              120.0) == []
+    # ... but all ranks inside past the deadline IS a stall (suspect
+    # coordinator connectivity, not a missing rank).
+    inflight[0]["ranks"] = {0: NOW - 500.0, 1: NOW - 130.0}
+    found = doctor.find_distributed_init_stall(inflight, NOW, 120.0)
+    assert [f["check"] for f in found] == ["distributed_init_stall"]
+    assert found[0]["data"]["missing_ranks"] == []
+
+
+def test_hung_collectives_skips_distributed_init_records():
+    # The rendezvous is watched by its own check with its own (longer)
+    # deadline — the gang-collective watchdog must not double-report.
+    inflight = [{"group": "g", "seq": 0, "op": "distributed_init",
+                 "backend": "xla", "world": 2,
+                 "ranks": {0: NOW - 300.0}}]
+    assert doctor.find_hung_collectives(inflight, NOW, 5.0) == []
+    found = doctor.find_distributed_init_stall(inflight, NOW, 120.0)
+    assert len(found) == 1
+
+
+def test_diagnose_carries_distributed_init_findings():
+    feed = {"collective_inflight": [
+        {"group": "train/x", "seq": 0, "op": "distributed_init",
+         "backend": "xla", "world": 3, "ranks": {0: NOW - 400.0}}]}
+    diag = doctor.diagnose(feed=feed, tasks=[], spans=[], load={},
+                           pgs=[], nodes=[], ledgers=[], now=NOW)
+    assert any(f["check"] == "distributed_init_stall"
+               for f in diag["findings"])
+    # A shorter operator-tuned deadline flags earlier...
+    diag2 = doctor.diagnose(feed=feed, tasks=[], spans=[], load={},
+                            pgs=[], nodes=[], ledgers=[], now=NOW,
+                            dist_init_timeout_s=1000.0)
+    assert not any(f["check"] == "distributed_init_stall"
+                   for f in diag2["findings"])
+
+
 # -------------------------------------------------------- stuck tasks
 def _task(tid, name, state, times):
     return {"task_id": tid, "name": name, "state": state,
