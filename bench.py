@@ -26,7 +26,7 @@ def _gpt2_bench_setup():
     """Shared model/optimizer setup for the GPT-2 benches: GPT-2 small
     on a real chip, a scaled-down copy on CPU so the bench stays
     runnable anywhere (vs_baseline is only meaningful on TPU).
-    Returns (cfg, on_tpu, state, optimizer, one_step)."""
+    Returns (cfg, on_tpu, state, optimizer, loss_fn, one_step)."""
     from ray_tpu.models.gpt2 import (GPT2Config, gpt2_init, gpt2_loss_fn)
     from ray_tpu.train.train_step import (TrainState, make_optimizer,
                                           make_train_step)
@@ -51,14 +51,15 @@ def _gpt2_bench_setup():
         return gpt2_loss_fn(cfg, p, b,
                             loss_chunk=256 if on_tpu else 0)
 
-    return cfg, on_tpu, state, optimizer, make_train_step(loss_fn,
-                                                          optimizer)
+    return cfg, on_tpu, state, optimizer, loss_fn, \
+        make_train_step(loss_fn, optimizer)
 
 
 def main() -> None:
     import os
 
-    cfg, on_tpu, state, optimizer, one_step = _gpt2_bench_setup()
+    cfg, on_tpu, state, optimizer, loss_fn, one_step = \
+        _gpt2_bench_setup()
     batch, steps, reps = (16, 20, 3) if on_tpu else (4, 3, 1)
     tokens = jax.random.randint(jax.random.PRNGKey(1),
                                 (batch, cfg.max_seq + 1), 0,
@@ -110,6 +111,28 @@ def main() -> None:
             f"empty goodput summary from bench run: {gp}")
     if abs(sum(fracs.values()) - 1.0) >= 1e-6:
         raise RuntimeError(f"goodput fractions don't normalize: {fracs}")
+    # Automated step decomposition (util/xprof): forward / backward /
+    # optimizer seconds via state-carried scans — the measurement
+    # MFU_ANALYSIS.md performs by hand, now a bench output every run.
+    from ray_tpu.util import xprof as _xprof
+
+    decomp = _xprof.measure_step_decomposition(
+        loss_fn, optimizer, state, {"tokens": tokens},
+        steps=steps, reps=reps,
+        flops_per_step=batch * cfg.max_seq * flops_per_token)
+    decomp_out = {
+        "forward_s": round(decomp["forward_s"], 6),
+        "backward_s": round(decomp["backward_s"], 6),
+        "optimizer_s": round(decomp["optimizer_s"], 6),
+        "full_step_s": round(decomp["full_step_s"], 6),
+        "shares": {k: round(v, 4)
+                   for k, v in decomp["shares"].items()},
+    }
+    if on_tpu and "of_peak" in decomp:
+        # Of-peak ratios only mean something against a real chip's
+        # peak; on CPU the resolved TPU peak would print noise.
+        decomp_out["of_peak"] = {k: round(v, 4)
+                                 for k, v in decomp["of_peak"].items()}
     out = {
         "metric": "gpt2_124m_pretrain_tokens_per_sec_per_chip"
         if on_tpu else "gpt2_scaled_cpu_tokens_per_sec",
@@ -117,9 +140,17 @@ def main() -> None:
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
         "goodput": {p: round(f, 4) for p, f in fracs.items()},
+        "decomposition": decomp_out,
     }
     print(json.dumps(out))
-    _maybe_record(out)
+    # The decomposition row rides along under --record: optimizer
+    # share is the "optimizer is ~free" MFU_ANALYSIS claim as a
+    # regression-guarded number (lower is better — a growing share
+    # means the update stopped overlapping/fusing).
+    _maybe_record(out, extra_rows=[
+        {"benchmark": "gpt2_step_optimizer_share",
+         "value": round(decomp["shares"]["optimizer"], 4),
+         "unit": "fraction", "higher_is_better": False}])
 
 
 def data_pipeline() -> None:
@@ -140,7 +171,8 @@ def data_pipeline() -> None:
     from ray_tpu import data as rt_data
     from ray_tpu import train as rt_train
 
-    cfg, on_tpu, state, optimizer, step_fn = _gpt2_bench_setup()
+    cfg, on_tpu, state, optimizer, _loss_fn, step_fn = \
+        _gpt2_bench_setup()
     batch, steps, n_blocks = (16, 20, 8) if on_tpu else (4, 12, 4)
     one_step = jax.jit(step_fn)
     rows_per_block = batch * steps // n_blocks
@@ -578,10 +610,11 @@ def fsdp() -> None:
     by the GPT-2 partition rules, and run jit-with-shardings train
     steps whose gradient reductions cross the process boundary (gloo).
     Records ``train_fsdp_tokens_per_sec`` (global tokens through the
-    sharded step) and the sharded-step MFU row into PERF.jsonl — the
-    row that catches a regression in the GSPMD path itself (extra
-    resharding copies, lost donation) that the single-chip headline
-    bench can't see."""
+    sharded step, a floor against GSPMD-path regressions — extra
+    resharding copies, lost donation) plus per-mesh-axis collective
+    byte shares harvested by util/xprof from the timed executable's
+    post-SPMD HLO.  An MFU row rides along only on real accelerators;
+    on the CPU gang that ratio measures nothing and is omitted."""
     import os
     import socket
     import subprocess
@@ -609,6 +642,7 @@ def fsdp() -> None:
     if member is None:
         raise RuntimeError(
             f"fsdp bench member 0 printed no result:\n{outs[0][-3000:]}")
+    on_accel = member.get("platform") in ("tpu", "axon")
     out = {
         "metric": "train_fsdp_tokens_per_sec",
         "value": round(member["tokens_per_sec"], 1),
@@ -617,12 +651,28 @@ def fsdp() -> None:
         "mesh": member["mesh"],
         "world": 2,
         "compile_s": round(member["compile_s"], 2),
-        "mfu": member["mfu"],
+        "platform": member.get("platform", "cpu"),
+        "collective_bytes": member.get("collective_bytes", 0.0),
+        "axis_shares": member.get("axis_shares", {}),
     }
+    # MFU against a TPU peak measures nothing on a CPU gang — keep
+    # the key (and its ledger row) only on real accelerators.
+    if on_accel:
+        out["mfu"] = member["mfu"]
     print(json.dumps(out))
-    _maybe_record(out, extra_rows=[
-        {"benchmark": "train_fsdp_mfu", "value": member["mfu"],
-         "unit": "fraction", "higher_is_better": True}])
+    # Axis byte shares are static facts of the compiled program; a
+    # rising fsdp/tensor share means the partitioner started moving
+    # more bytes over that axis per step (lower is better).
+    rows = [
+        {"benchmark": f"train_fsdp_collective_share_{axis}",
+         "value": share, "unit": "fraction", "higher_is_better": False}
+        for axis, share in sorted(member.get("axis_shares",
+                                             {}).items())]
+    if on_accel:
+        rows.append({"benchmark": "train_fsdp_mfu",
+                     "value": member["mfu"], "unit": "fraction",
+                     "higher_is_better": True})
+    _maybe_record(out, extra_rows=rows)
 
 
 def _fsdp_member(rank: int, addr: str) -> None:
@@ -655,11 +705,14 @@ def _fsdp_member(rank: int, addr: str) -> None:
     state, specs = dist.shard_train_state(
         state, mesh, dist.rules_for_model("gpt2"))
     shardings = tree_shardings(mesh, specs)
+    # telemetry=True: the step compiles through the AOT path, so the
+    # xprof plane harvests the post-SPMD HLO — per-axis collective
+    # bytes come from the SAME executable the bench times.
     step = make_sharded_train_step(
         lambda p, b: gpt2_loss_fn(cfg, p, b, loss_chunk=0), optimizer,
         mesh=mesh, state_shardings=shardings,
         batch_sharding=NamedSharding(mesh, PartitionSpec("fsdp")),
-        telemetry=False)
+        telemetry=True)
     gbs, steps = 8, 6
     rng = np.random.default_rng(0)
     full = rng.integers(0, cfg.vocab_size,
@@ -680,10 +733,24 @@ def _fsdp_member(rank: int, addr: str) -> None:
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     peak = _PEAK_FLOPS.get(gen, _PEAK_FLOPS["v5e"]) * len(jax.devices())
     mfu = tok_s * cfg.flops_per_token() / peak
+    # Per-axis collective byte shares from the xprof plane: static
+    # post-SPMD HLO facts of the timed executable (deterministic per
+    # compile — unlike timing, safe to regression-guard).
+    from ray_tpu.util import xprof
+
+    colls = (xprof.local_programs().get("train_step") or {}).get(
+        "collectives") or {}
+    total_cbytes = sum(a.get("bytes", 0.0) for a in colls.values())
+    axis_shares = {
+        axis: round(a.get("bytes", 0.0) / total_cbytes, 4)
+        for axis, a in colls.items()} if total_cbytes > 0 else {}
     if rank == 0:
         print("FSDP-MEMBER-0 " + json.dumps(
             {"tokens_per_sec": tok_s, "compile_s": compile_s,
              "mesh": shape, "mfu": round(mfu, 6),
+             "platform": jax.devices()[0].platform,
+             "collective_bytes": total_cbytes,
+             "axis_shares": axis_shares,
              "loss": dist.metrics_to_host(metrics)["loss"]}),
             flush=True)
 

@@ -219,6 +219,18 @@ class Worker:
                 now = time.time()
                 if now - last_metrics >= period:
                     last_metrics = now
+                    import sys as _sys
+
+                    # Device-memory watermarks ride the metrics tick,
+                    # but only once user code has already paid the jax
+                    # import — a no-jax worker must not drag it in.
+                    if "jax" in _sys.modules:
+                        try:
+                            from ray_tpu.util import xprof as _xprof
+
+                            _xprof.publish_device_memory()
+                        except Exception:
+                            pass
                     from ray_tpu.util.metrics import registry
 
                     snap = registry().snapshot()

@@ -69,6 +69,7 @@ async function refresh() {
     `<a href="/api/telemetry?format=text">/api/telemetry</a> ` +
     `(goodput/MFU) · ` +
     `<a href="/api/doctor?format=text">/api/doctor</a> (health) · ` +
+    `<a href="/api/perf?format=text">/api/perf</a> (roofline) · ` +
     `<a href="/api/slo?format=text">/api/slo</a> (error budgets) · ` +
     `<a href="/api/trace">/api/trace</a> (slow requests) · ` +
     `<a href="/api/timeline">/api/timeline</a> (Perfetto trace)</p>`;
@@ -162,6 +163,21 @@ def create_app(address: Optional[str] = None):
                                 content_type="text/plain")
         return web.json_response(
             json.loads(json.dumps(diag, default=repr)))
+
+    async def perf(req):
+        """/api/perf — the XLA performance introspection report
+        (`rt perf` JSON): roofline position, step decomposition,
+        per-axis collective shares, compile events, device-memory
+        watermarks.  ?format=text renders the CLI report."""
+        from ..util import xprof as xprof_mod
+
+        rep = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: xprof_mod.cluster_report(address=address))
+        if req.query.get("format") == "text":
+            return web.Response(text=xprof_mod.render_report(rep),
+                                content_type="text/plain")
+        return web.json_response(
+            json.loads(json.dumps(rep, default=repr)))
 
     async def slo(req):
         """/api/slo — the SLO / error-budget report (`rt slo` JSON):
@@ -317,6 +333,7 @@ def create_app(address: Optional[str] = None):
     app.router.add_get("/api/profile", profile)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/api/doctor", doctor)
+    app.router.add_get("/api/perf", perf)
     app.router.add_get("/api/telemetry", telemetry)
     app.router.add_get("/api/timeline", timeline)
     app.router.add_get("/api/slo", slo)

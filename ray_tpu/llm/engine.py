@@ -159,6 +159,10 @@ class GenerationEngine:
         # ([max_batch, 1]); XLA specializes per shape.  Donating the
         # pooled KV buffers makes the update in-place on TPU.
         self._fwd = jax.jit(fwd, donate_argnums=(2, 3))
+        # Per-shape AOT executables (lower().compile()): the compile
+        # is timed and the program registered with the xprof plane
+        # (rt perf); None marks a shape that fell back to plain jit.
+        self._fwd_cache: Dict[Any, Any] = {}
 
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -458,6 +462,51 @@ class GenerationEngine:
         row[:len(seq.pages)] = seq.pages
         return row
 
+    def _call_fwd(self, kind: str, *args):
+        """Dispatch the forward through a per-shape AOT executable.
+
+        First sight of a (kind, token-shape) pair pays the one compile
+        jit would pay anyway, but via ``lower().compile()`` so the
+        compile is timed, counted (``rt_xla_compiles_total``) and the
+        program's cost/memory/collective facts registered with the
+        xprof plane.  Any AOT failure falls back to the plain jit path
+        — observability must never fail the request path."""
+        key = (kind, args[1].shape)
+        cached = self._fwd_cache.get(key)
+        # A cache entry is only valid for the _fwd it was compiled
+        # from — if _fwd was swapped (fault injection, hot reload) the
+        # stale executable must not keep serving.
+        if cached is None or cached[0] is not self._fwd:
+            exe = None
+            t0 = time.perf_counter()
+            try:
+                exe = self._fwd.lower(*args).compile()
+            except Exception:
+                exe = None
+            try:
+                from ..util import xprof
+
+                name = f"llm_{kind}[{args[1].shape[1]}]" \
+                    if kind == "prefill" else f"llm_{kind}"
+                if exe is not None:
+                    xprof.register_compiled(
+                        name, exe,
+                        compile_seconds=time.perf_counter() - t0)
+                else:
+                    xprof.count_compile(
+                        name, time.perf_counter() - t0)
+            except Exception:
+                pass
+            self._fwd_cache[key] = (self._fwd, exe)
+        _, exe = self._fwd_cache[key]
+        if exe is None:
+            return self._fwd(*args)
+        try:
+            return exe(*args)
+        except Exception:
+            self._fwd_cache[key] = (self._fwd, None)
+            return self._fwd(*args)
+
     def _prefill(self, seq: _Sequence) -> None:
         n = len(seq.tokens)
         # First admission only (a recompute-preempted sequence
@@ -479,9 +528,10 @@ class GenerationEngine:
         positions = np.full((1, pad), -1, np.int32)
         positions[0, :n] = np.arange(n)
         table = self._page_table_row(seq)[None, :]
-        logits, k, v = self._fwd(self._params, tokens,
-                                 self._kv["k_pages"],
-                                 self._kv["v_pages"], table, positions)
+        logits, k, v = self._call_fwd("prefill", self._params, tokens,
+                                      self._kv["k_pages"],
+                                      self._kv["v_pages"], table,
+                                      positions)
         self._kv["k_pages"], self._kv["v_pages"] = k, v
         seq.n_cached = n
         self._prefill_tokens_total += n
@@ -513,9 +563,10 @@ class GenerationEngine:
             tokens[i, 0] = seq.tokens[-1]
             positions[i, 0] = seq.n_cached
             table[i] = self._page_table_row(seq)
-        logits, k, v = self._fwd(self._params, tokens,
-                                 self._kv["k_pages"],
-                                 self._kv["v_pages"], table, positions)
+        logits, k, v = self._call_fwd("decode", self._params, tokens,
+                                      self._kv["k_pages"],
+                                      self._kv["v_pages"], table,
+                                      positions)
         self._kv["k_pages"], self._kv["v_pages"] = k, v
         logits_np = np.asarray(logits[:, 0])
         for i, seq in enumerate(batch):

@@ -518,6 +518,27 @@ def cmd_doctor(args) -> int:
     return 1 if critical else 0
 
 
+def cmd_perf(args) -> int:
+    """XLA performance introspection plane: roofline position
+    (achieved vs attainable FLOP/s at the program's arithmetic
+    intensity), step decomposition, per-mesh-axis collective
+    byte/time shares, compile events, and device-memory watermarks —
+    assembled from the rt_xla_* gauges registered compiled programs
+    publish (util/xprof.py)."""
+    from ray_tpu.util import xprof as xprof_mod
+
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    rep = xprof_mod.cluster_report(address=address)
+    if args.format == "json" or getattr(args, "json", False):
+        print(json.dumps(rep, indent=2, default=repr))
+    else:
+        sys.stdout.write(xprof_mod.render_report(rep))
+    return 0
+
+
 def cmd_checkpoint_verify(args) -> int:
     """Offline integrity check of one checkpoint directory: commit
     status, manifest sanity, per-shard-file checksums, and slice
@@ -1042,6 +1063,18 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--format", choices=["text", "json"],
                     default="text")
     sp.set_defaults(fn=cmd_slo)
+
+    sp = sub.add_parser("perf",
+                        help="XLA perf introspection (roofline, step "
+                             "decomposition, per-axis collective "
+                             "shares, compiles, device memory)")
+    sp.add_argument("--address", default="")
+    sp.add_argument("--format", choices=["text", "json"],
+                    default="text")
+    sp.add_argument("--json", action="store_true",
+                    help="shorthand for --format json (scripted "
+                         "consumption in bench/CI)")
+    sp.set_defaults(fn=cmd_perf)
 
     sp = sub.add_parser("doctor",
                         help="aggregated cluster health diagnosis "

@@ -42,12 +42,23 @@ class FailureConfig:
 
 
 # bf16 peak FLOPs/s per chip by TPU generation (public spec sheets) —
-# shared by the telemetry plane and bench.py.
+# shared by the telemetry plane and bench.py.  util/xprof.py keeps a
+# jax-free mirror of these tables (importing this module executes the
+# train package __init__, which drags jax); tests/test_xprof.py pins
+# the two against each other.
 PEAK_FLOPS_BY_GEN: Dict[str, float] = {
     "v4": 275e12,
     "v5e": 197e12,
     "v5p": 459e12,
     "v6e": 918e12,
+}
+
+# HBM bandwidth per chip — the roofline's memory roof.
+PEAK_HBM_BYTES_PER_SEC_BY_GEN: Dict[str, float] = {
+    "v4": 1228e9,
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v6e": 1638e9,
 }
 
 

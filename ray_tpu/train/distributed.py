@@ -180,6 +180,16 @@ def rules_for_model(name: str):
 # jax layer — gang bootstrap, sharded placement, global batches
 # ===================================================================
 
+def mesh_axis_sizes(mesh) -> Dict[str, int]:
+    """THE ordered {axis: size} mapping of a jax Mesh — ordered as the
+    mesh's device array is laid out, which is the order replica-group
+    device ids unravel to mesh coordinates (util/xprof.py's
+    collective-to-axis attribution) and the order sharded checkpoints
+    record as ``mesh_axes``.  One definition so the two planes cannot
+    disagree."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
 @dataclass
 class DistributedMesh:
     """The gang's resolved mesh plus the topology facts train loops
@@ -201,9 +211,9 @@ class DistributedMesh:
         from ..parallel.partition_rules import prune_spec
 
         spec = PS("fsdp") if spec is None else spec
-        sizes = dict(zip(self.mesh.axis_names,
-                         self.mesh.devices.shape))
-        return NamedSharding(self.mesh, prune_spec(spec, sizes))
+        return NamedSharding(self.mesh,
+                             prune_spec(spec,
+                                        mesh_axis_sizes(self.mesh)))
 
     def batch_slice(self, global_batch_size: int) -> Tuple[int, int]:
         """The rows of the global batch THIS rank feeds."""

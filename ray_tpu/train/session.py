@@ -157,6 +157,13 @@ class TrainSession:
                       "Achieved model FLOPs utilization (0-1) from "
                       "the declared FLOPs-per-token figure.").set(
                     tps * tel.model_flops_per_token / peak)
+                # The roofline's measured point: achieved model
+                # FLOP/s per worker (rt perf plots it against the
+                # attainable ceiling at the program's intensity).
+                Gauge("rt_train_achieved_flops_per_sec",
+                      "Achieved model FLOP/s per worker from the "
+                      "declared FLOPs-per-token figure.").set(
+                    tps * tel.model_flops_per_token)
         except Exception:
             pass  # telemetry must never fail a training step
 

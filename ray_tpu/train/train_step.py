@@ -131,21 +131,67 @@ def make_sharded_train_step(loss_fn, optimizer, mesh=None,
 
     from ..util import goodput
 
-    compiled = [False]
+    mesh_axes = None
+    if mesh is not None:
+        try:
+            from .distributed import mesh_axis_sizes
+
+            mesh_axes = mesh_axis_sizes(mesh)
+        except Exception:
+            pass
+
+    # aot[0]: None = first call pending, False = fell back to the
+    # shape-polymorphic jit path, else the AOT-compiled executable
+    # (the execution path from call one — compiling via
+    # ``lower().compile()`` instead of jit's implicit cache lets the
+    # xprof plane harvest cost/memory/collective facts without paying
+    # a second compile).
+    aot = [None]
 
     def timed_step(state, batch):
-        phase = "compute" if compiled[0] else "compile"
+        first = aot[0] is None
+        phase = "compile" if first else "compute"
         t0 = _time.perf_counter()
         with goodput.ledger().phase(phase):
-            out = jitted(state, batch)
+            if first:
+                try:
+                    aot[0] = jitted.lower(state, batch).compile()
+                except Exception:
+                    aot[0] = False
+            exe = aot[0] if aot[0] else jitted
+            try:
+                out = exe(state, batch)
+            except Exception:
+                if exe is jitted:
+                    raise
+                # New input shapes/shardings vs the AOT executable:
+                # fall back to the polymorphic jit path for good and
+                # count the recompile.
+                aot[0] = False
+                rt0 = _time.perf_counter()
+                out = jitted(state, batch)
+                try:
+                    from ..util import xprof
+
+                    xprof.count_compile(
+                        "train_step",
+                        _time.perf_counter() - rt0)
+                except Exception:
+                    pass
         dt = _time.perf_counter() - t0
         try:
             from ..util.metrics import Gauge, Histogram
 
-            if not compiled[0]:
+            if first:
                 Gauge("rt_train_compile_seconds",
                       "Host-side duration of the first (tracing + "
                       "XLA compile) step invocation.").set(dt)
+                if aot[0]:
+                    from ..util import xprof
+
+                    xprof.register_compiled("train_step", aot[0],
+                                            mesh_axes=mesh_axes,
+                                            compile_seconds=dt)
             else:
                 Histogram("rt_train_step_dispatch_seconds",
                           "Host-side duration of the jitted step call "
@@ -153,7 +199,6 @@ def make_sharded_train_step(loss_fn, optimizer, mesh=None,
                           ).observe(dt)
         except Exception:
             pass
-        compiled[0] = True
         return out
 
     return timed_step

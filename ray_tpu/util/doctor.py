@@ -947,6 +947,89 @@ def find_flight_dumps(dumps: List[Dict], now: float,
     return out
 
 
+# -------------------------------------------- XLA introspection plane
+def find_recompile_churn(metric_sources: Dict[str, List[Dict]],
+                         min_compiles: float = 8.0) -> List[Dict]:
+    """Flag functions recompiling over and over on one process —
+    ``rt_xla_compiles_total`` (util/xprof.py) should count a handful
+    of distinct shapes per function (a train step compiles once; the
+    LLM engine compiles one prefill program per power-of-two bucket);
+    tens of compiles means a shape leak (unpadded batch, drifting
+    sequence length) burning step time on XLA compiles."""
+    out = []
+    for src, snaps in (metric_sources or {}).items():
+        for snap in snaps:
+            if snap.get("name") != "rt_xla_compiles_total":
+                continue
+            for s in snap.get("series", []):
+                count = float(s.get("value", 0.0))
+                if count < min_compiles:
+                    continue
+                fn = (s.get("tags") or {}).get("fn", "?")
+                out.append(_finding(
+                    "recompile_churn", "warning",
+                    f"{fn} compiled {count:.0f}x on {src}",
+                    detail="A jitted function recompiling this often "
+                           "usually means its input shapes are not "
+                           "stable (unpadded/bucketless batches); "
+                           "every recompile stalls the step for the "
+                           "full XLA compile.",
+                    probe="rt perf   # per-program compile seconds",
+                    data={"source": src, "fn": fn,
+                          "compiles": count}))
+    return out
+
+
+def find_device_memory_pressure(metric_sources: Dict[str, List[Dict]],
+                                warn_frac: float = 0.90,
+                                critical_frac: float = 0.98
+                                ) -> List[Dict]:
+    """Flag devices whose HBM watermarks approach the limit
+    (``rt_xla_device_memory_bytes``, polled per flush tick): the next
+    allocation spike — a longer sequence, a checkpoint gather — turns
+    this into an OOM that kills the gang."""
+    out = []
+    for src, snaps in (metric_sources or {}).items():
+        for snap in snaps:
+            if snap.get("name") != "rt_xla_device_memory_bytes":
+                continue
+            per_dev: Dict[str, Dict[str, float]] = {}
+            for s in snap.get("series", []):
+                tags = s.get("tags") or {}
+                per_dev.setdefault(tags.get("device", "?"), {})[
+                    tags.get("kind", "?")] = float(
+                        s.get("value", 0.0))
+            for dev, kinds in sorted(per_dev.items()):
+                limit = kinds.get("limit", 0.0)
+                if limit <= 0:
+                    continue
+                used = kinds.get("used", 0.0)
+                peak = kinds.get("peak", 0.0)
+                frac = used / limit
+                peak_frac = peak / limit
+                if frac >= critical_frac:
+                    sev = "critical"
+                elif frac >= warn_frac or peak_frac >= critical_frac:
+                    sev = "warning"
+                else:
+                    continue
+                out.append(_finding(
+                    "device_memory_pressure", sev,
+                    f"device {dev} on {src} at "
+                    f"{100 * frac:.1f}% of HBM "
+                    f"(peak {100 * peak_frac:.1f}%)",
+                    detail=f"used {used / 1e9:.2f}GB, peak "
+                           f"{peak / 1e9:.2f}GB of "
+                           f"{limit / 1e9:.2f}GB; the next "
+                           f"allocation spike OOMs the process and "
+                           f"takes the gang with it.",
+                    probe="rt perf   # program memory breakdown",
+                    data={"source": src, "device": dev,
+                          "used_frac": frac,
+                          "peak_frac": peak_frac}))
+    return out
+
+
 # ----------------------------------------------------- orchestration
 def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
              load: Dict, pgs: List[Dict], nodes: List[Dict],
@@ -963,7 +1046,12 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
              slo: Optional[Dict] = None,
              exemplars: Optional[List[Dict]] = None,
              serve_spans: Optional[List[Dict]] = None,
-             slow_request_s: float = 2.0) -> Dict[str, Any]:
+             slow_request_s: float = 2.0,
+             metric_sources: Optional[Dict[str, List[Dict]]] = None,
+             recompile_churn_min: float = 8.0,
+             device_memory_warn_frac: float = 0.90,
+             device_memory_critical_frac: float = 0.98
+             ) -> Dict[str, Any]:
     """Pure aggregation of every check over already-fetched state
     (unit-testable without a cluster)."""
     now = time.time() if now is None else now
@@ -998,6 +1086,11 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
                                    spans=serve_spans,
                                    threshold_s=slow_request_s)
     findings += find_flight_dumps(feed.get("flight") or [], now)
+    findings += find_recompile_churn(metric_sources or {},
+                                     min_compiles=recompile_churn_min)
+    findings += find_device_memory_pressure(
+        metric_sources or {}, warn_frac=device_memory_warn_frac,
+        critical_frac=device_memory_critical_frac)
     findings.sort(key=lambda f: _SEV_ORDER.get(f["severity"], 9))
     return {
         "ts": now,
@@ -1134,7 +1227,17 @@ def cluster_diagnosis(*, address: Optional[str] = None,
         slo=slo_report, exemplars=exemplars,
         serve_spans=serve_spans,
         slow_request_s=float(os.environ.get("RT_SLOW_REQUEST_S",
-                                            "2.0")))
+                                            "2.0")),
+        # Reuse the telemetry snapshot fetched above for the XLA-plane
+        # checks (recompile churn, device-memory pressure).
+        metric_sources=tel_sources,
+        recompile_churn_min=float(
+            os.environ.get("RT_RECOMPILE_CHURN_MIN", "8")),
+        device_memory_warn_frac=float(
+            os.environ.get("RT_DEVICE_MEMORY_WARN_FRAC", "0.90")),
+        device_memory_critical_frac=float(
+            os.environ.get("RT_DEVICE_MEMORY_CRITICAL_FRAC",
+                           "0.98")))
 
 
 def render_text(diag: Dict[str, Any]) -> str:

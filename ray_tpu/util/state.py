@@ -328,6 +328,16 @@ def doctor(*, address: Optional[str] = None) -> Dict[str, Any]:
     return doctor_mod.cluster_diagnosis(address=address)
 
 
+def perf(*, address: Optional[str] = None) -> Dict[str, Any]:
+    """The XLA performance introspection report (``rt perf`` /
+    ``/api/perf``): roofline position, step decomposition, per-axis
+    collective shares, compile events, device-memory watermarks; see
+    util/xprof.py."""
+    from . import xprof as xprof_mod
+
+    return xprof_mod.cluster_report(address=address)
+
+
 def summarize_tasks(*, address: Optional[str] = None) -> Dict[str, int]:
     counts: Dict[str, int] = {}
     for rec in list_tasks(limit=100000, address=address):

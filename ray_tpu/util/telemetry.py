@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 TRAIN_GAUGES = ("rt_train_step", "rt_train_tokens_per_sec",
                 "rt_train_mfu", "rt_train_compile_seconds",
+                "rt_train_achieved_flops_per_sec",
                 "rt_train_workers")
 TRAIN_HISTS = ("rt_train_step_time_seconds",
                "rt_train_data_wait_seconds",
@@ -105,8 +106,50 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
            "prefill_tokens": 0.0, "evictions": 0.0, "engines": 0}
     checkpoints: Dict[str, Any] = {"bytes": 0.0, "shards": 0.0,
                                    "save": {}, "restore": {}}
+    # XLA introspection plane (util/xprof.py): per-program static
+    # facts are identical on every rank (max-merge); compile counts/
+    # seconds accumulate (sum across sources).
+    xla_programs: Dict[str, Dict[str, Any]] = {}
+    xla_devmem: Dict[str, Dict[str, Dict[str, float]]] = {}
+
+    def _xla_prog(fn: str) -> Dict[str, Any]:
+        return xla_programs.setdefault(
+            fn, {"flops": 0.0, "bytes": 0.0, "memory": {},
+                 "collectives": {}, "compiles": 0.0,
+                 "compile_seconds": 0.0})
+
     for src, snap in _iter_metrics(sources):
         name = snap.get("name", "")
+        if name.startswith("rt_xla_"):
+            for s in snap.get("series", []):
+                tags = s.get("tags") or {}
+                val = float(s.get("value", 0.0))
+                if name == "rt_xla_device_memory_bytes":
+                    dev = xla_devmem.setdefault(src, {}).setdefault(
+                        tags.get("device", "?"), {})
+                    dev[tags.get("kind", "?")] = val
+                    continue
+                prog = _xla_prog(tags.get("fn", "?"))
+                if name == "rt_xla_cost_flops":
+                    prog["flops"] = max(prog["flops"], val)
+                elif name == "rt_xla_cost_bytes":
+                    prog["bytes"] = max(prog["bytes"], val)
+                elif name == "rt_xla_memory_bytes":
+                    kind = tags.get("kind", "?")
+                    prog["memory"][kind] = max(
+                        prog["memory"].get(kind, 0.0), val)
+                elif name == "rt_xla_collective_bytes":
+                    axis = tags.get("axis", "?")
+                    a = prog["collectives"].setdefault(
+                        axis, {"bytes": 0.0, "by_op": {}})
+                    op = tags.get("op", "?")
+                    a["by_op"][op] = max(a["by_op"].get(op, 0.0),
+                                         val)
+                elif name == "rt_xla_compiles_total":
+                    prog["compiles"] += val
+                elif name == "rt_xla_compile_seconds_total":
+                    prog["compile_seconds"] += val
+            continue
         if name in ("rt_checkpoint_bytes", "rt_checkpoint_shards"):
             key = "bytes" if name.endswith("bytes") else "shards"
             for s in snap.get("series", []):
@@ -300,9 +343,18 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
         if keep:
             series[src] = keep
 
+    # Collective bytes of one program are per-axis sums of its by_op
+    # maxima (recomputed after the merge so partial snapshots from
+    # several sources cannot double count).
+    for prog in xla_programs.values():
+        for a in prog["collectives"].values():
+            a["bytes"] = sum(a["by_op"].values())
+
     return {
         "ts": raw.get("ts"),
         "slo": slo_report,
+        "xla": {"programs": xla_programs,
+                "device_memory": xla_devmem},
         "goodput": goodput_mod.summarize_sources(sources),
         "train": train,
         "train_series": series,
@@ -351,7 +403,16 @@ def render_text(summary: Dict[str, Any]) -> str:
             lines.append(f"  {job:<24} {total:8.1f}s   {top}")
 
     train = summary.get("train", {})
-    if train:
+    xla = summary.get("xla") or {}
+    xla_programs = xla.get("programs") or {}
+    # Compile seconds per source come from the xprof counters when
+    # present (count + cumulative seconds beat the first-step-only
+    # rt_train_compile_seconds gauge).
+    compile_total = sum(p.get("compile_seconds", 0.0)
+                        for p in xla_programs.values())
+    compile_count = sum(p.get("compiles", 0.0)
+                        for p in xla_programs.values())
+    if train or compile_count:
         lines.append("\nTraining:")
         for src in sorted(train):
             row = train[src]
@@ -364,6 +425,15 @@ def render_text(summary: Dict[str, Any]) -> str:
             if "rt_train_mfu" in row:
                 lines.append(f"    MFU                 "
                              f"{100 * row['rt_train_mfu']:.2f}%")
+            if "rt_train_achieved_flops_per_sec" in row:
+                lines.append(
+                    "    achieved FLOP/s     "
+                    f"{_fmt_rate(row['rt_train_achieved_flops_per_sec'])}")
+            if "rt_train_compile_seconds" in row:
+                lines.append(
+                    f"    compile             "
+                    f"{row['rt_train_compile_seconds']:.2f}s "
+                    f"(first step)")
             st = row.get("rt_train_step_time_seconds")
             if st:
                 lines.append(f"    step time           mean "
@@ -381,6 +451,24 @@ def render_text(summary: Dict[str, Any]) -> str:
                 if h and h["count"]:
                     lines.append(f"    {label:<19} mean "
                                  f"{h['mean'] * 1e3:.1f}ms  n={h['count']}")
+        if compile_count:
+            lines.append(f"  XLA compiles        {compile_count:.0f} "
+                         f"({compile_total:.2f}s total; `rt perf` "
+                         f"for per-program detail)")
+    devmem = xla.get("device_memory") or {}
+    if any(devmem.values()):
+        lines.append("\nDevice memory (used/peak/limit):")
+        for src in sorted(devmem):
+            for dev in sorted(devmem[src]):
+                row = devmem[src][dev]
+                limit = row.get("limit", 0.0)
+                pct = (f"  ({100 * row.get('used', 0.0) / limit:.1f}%"
+                       f" used)") if limit else ""
+                lines.append(
+                    f"  {src} dev{dev}: "
+                    f"{_fmt_rate(row.get('used', 0.0))}B / "
+                    f"{_fmt_rate(row.get('peak', 0.0))}B / "
+                    f"{_fmt_rate(row.get('limit', 0.0))}B{pct}")
 
     cols = summary.get("collectives", [])
     if cols:
