@@ -191,11 +191,14 @@ class RemoteFunction:
             scheduling=_strategy(opts),
             runtime_env=opts["runtime_env"],
         )
-        from ..util import tracing
+        from ..util import hotpath, tracing
 
         # Injected when tracing is on OR a serve request context is
         # active (request-scoped tracing works without the flag).
         tracing.maybe_inject(spec, cfg.tracing_enabled)
+        # Hot-path introspection: a sampled 1-in-N task carries a
+        # phase-stamp vector through the whole lifecycle (rt hotpath).
+        hotpath.maybe_sample(spec, cfg.hotpath_sample)
         refs = rt.submit_task(spec)
         if spec.is_streaming:
             return refs[0]  # an ObjectRefGenerator

@@ -269,6 +269,10 @@ class ClusterRuntime(BaseRuntime):
         self._sched_ev_lock = threading.Lock()
         self._sched_ev_dropped = 0
         self._sched_flusher_started = False
+        # Hot-path introspection: completed phase records (sampled
+        # tasks only) ride the same 0.5s task_events flush — zero
+        # extra wakeups or RPCs on the submission path.
+        self._hotpath_buf: List[Dict] = []
         # Actor replies awaiting redelivery across an owner reconnect
         # (reply_id set; guards double-spawn on repeated disconnects).
         self._redelivering: Set[int] = set()
@@ -709,17 +713,49 @@ class ClusterRuntime(BaseRuntime):
                 batch, self._sched_ev_buf = self._sched_ev_buf, []
                 dropped, self._sched_ev_dropped = \
                     self._sched_ev_dropped, 0
-            if not batch and not dropped:
+                hp_batch, self._hotpath_buf = self._hotpath_buf, []
+            if not batch and not dropped and not hp_batch:
                 continue
+            payload = {"events": batch, "dropped": dropped}
+            if hp_batch:
+                payload["hotpath"] = hp_batch
+                payload["source"] = self.caller_tag
             try:
-                await self._ctl.call("task_events", {
-                    "events": batch, "dropped": dropped})
+                await self._ctl.call("task_events", payload)
             except (RpcError, RemoteCallError,
                     asyncio.CancelledError):
                 # Explainability is best-effort, but keep the drop
-                # tally for the next successful flush.
+                # tally for the next successful flush.  (Hot-path
+                # records are sampled observability — dropped.)
                 with self._sched_ev_lock:
                     self._sched_ev_dropped += dropped
+
+    def _hotpath_record(self, spec: TaskSpec, hp: List[float]) -> None:
+        """Io loop: stamp OWNER_DONE, fold the vector into a phase
+        record, and buffer it for the task_events flush tick.  Never
+        raises — this sits on the result-accept path."""
+        try:
+            from ..util import hotpath as _hotpath
+
+            hp[_hotpath.OWNER_DONE] = time.perf_counter()
+            rec = _hotpath.record_from_stamps(hp, spec.display_name())
+            if rec is None:
+                return
+            with self._sched_ev_lock:
+                self._hotpath_buf.append(rec)
+                if len(self._hotpath_buf) > 4096:
+                    del self._hotpath_buf[:2048]
+                start = not self._sched_flusher_started
+                if start:
+                    self._sched_flusher_started = True
+            if start:
+                from .rpc import spawn_task
+
+                self.io.call_soon(
+                    lambda: spawn_task(self._sched_event_flush_loop(),
+                                       self.io.loop))
+        except Exception:
+            pass
 
     async def _worker_client(self, addr: str) -> RpcClient:
         cli = self._worker_clients.get(addr)
@@ -1175,6 +1211,10 @@ class ClusterRuntime(BaseRuntime):
 
             self._lease_sweeper = spawn_task(self._lease_sweep_loop())
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        if spec.hp is not None:
+            from ..util.hotpath import POOL_ENQUEUE
+
+            spec.hp[POOL_ENQUEUE] = time.perf_counter()
         st.queue.append((spec, sub, fut,
                          asyncio.get_event_loop().time()))
         self._pump_key(st)
@@ -1375,6 +1415,10 @@ class ClusterRuntime(BaseRuntime):
                     stream.worker_addr = pl.worker_addr
             rfut = loop.create_future()
             self._reply_waiters[rid] = ("pool", rfut, st, pl, item)
+            if spec.hp is not None:
+                from ..util.hotpath import OWNER_SEND
+
+                spec.hp[OWNER_SEND] = time.perf_counter()
             payload_tasks.append({"spec": spec, "reply_id": rid})
             rfuts.append(rfut)
         try:
@@ -1413,6 +1457,11 @@ class ClusterRuntime(BaseRuntime):
                 if not rfut.done():
                     rfut.set_result("requeue")
                 continue
+            hp = getattr(res, "hp", None)
+            if hp is not None:
+                from ..util.hotpath import OWNER_REPLY_RECV
+
+                hp[OWNER_REPLY_RECV] = time.perf_counter()
             if not fut.done():
                 fut.set_result(res)
             if not rfut.done():
@@ -1846,6 +1895,9 @@ class ClusterRuntime(BaseRuntime):
         if spec.is_streaming:
             self._finalize_stream(spec, result)
             return
+        hp = getattr(result, "hp", None)
+        if hp is not None:
+            self._hotpath_record(spec, hp)
         from . import serialization
 
         oids = spec.return_object_ids()

@@ -292,6 +292,11 @@ define_flag("straggler_threshold", float, 0.2,
             "Straggler detector: a rank whose step time exceeds the "
             "per-step median by this fraction, sustained over the "
             "sliding window of recent steps, is flagged.")
+define_flag("hotpath_sample", int, 64,
+            "Control-plane hot-path introspection sampling stride: "
+            "1 in N submitted tasks carries a phase-stamp vector "
+            "(owner submit -> lease -> exec -> reply) aggregated "
+            "behind `rt hotpath`.  1 = every task, 0 disables.")
 # TPU-specific flags.
 define_flag("tpu_chips_per_host", int, 0,
             "Override detected TPU chip count (0 = autodetect).")

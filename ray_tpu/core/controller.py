@@ -130,6 +130,12 @@ class Controller:
 
         self.task_records: "OrderedDict[str, Dict]" = OrderedDict()
         self.task_events_dropped = 0
+        # Hot-path phase sink: sampled task stamp records (sliced into
+        # named lifecycle phases by the owner) arriving piggybacked on
+        # task_events flushes; `rt hotpath` reads its snapshot.
+        from ray_tpu.util.hotpath import Sink as _HotpathSink
+
+        self.hotpath_sink = _HotpathSink()
         # Cluster metrics: latest snapshot per reporting source (ref:
         # metrics agent / opencensus exporter, metric_defs.cc).
         self.metrics_sources: Dict[str, Any] = {}
@@ -184,7 +190,8 @@ class Controller:
             "get_placement_group", "list_placement_groups",
             "list_actors", "cluster_shutdown", "ping", "drain_node",
             "node_draining",
-            "task_events", "list_tasks", "get_task", "list_objects",
+            "task_events", "hotpath", "list_tasks", "get_task",
+            "list_objects",
             "list_jobs", "report_metrics", "metrics_text",
             "metrics_history", "get_load_metrics", "worker_logs",
             "telemetry", "report_flight_dump",
@@ -962,6 +969,11 @@ class Controller:
         # flush count as drops too — a gapped `rt explain` chain must
         # be attributable to backpressure, not read as a phantom bug.
         self.task_events_dropped += int(p.get("dropped") or 0)
+        hp = p.get("hotpath")
+        if hp:
+            # Sampled phase-stamp records piggybacked on the owner's
+            # event flush — aggregated here, read by `rt hotpath`.
+            self.hotpath_sink.add(p.get("source") or "", hp)
         for ev in p["events"]:
             tid = ev["task_id"]
             rec = self.task_records.get(tid)
@@ -1510,15 +1522,43 @@ class Controller:
                     if now - v["ts"] > horizon]:
             del self.metrics_sources[src]
 
+    async def hotpath(self, p):
+        """Cluster-wide hot-path phase decomposition: aggregated
+        sampled task stamp records (`rt hotpath`, /api/hotpath)."""
+        return self.hotpath_sink.snapshot()
+
+    def _self_metric_snaps(self):
+        """Controller-process introspection rendered in registry
+        snapshot shape: its own event-loop lag, RPC handler stats and
+        the cluster-wide task-event drop counter — so the controller
+        shows up in telemetry/doctor like any other reporting source."""
+        snaps = [
+            {"name": "rt_task_events_dropped_total", "kind": "counter",
+             "description": "Task lifecycle events dropped cluster-wide"
+                            " (owner-side trims + controller evictions).",
+             "series": [{"tags": {},
+                         "value": float(self.task_events_dropped)}]},
+        ]
+        lag = getattr(self, "_loop_lag", None)
+        if lag is not None:
+            snaps.extend(lag.metric_snaps())
+        snaps.extend(self.server.stats.metric_snaps())
+        return snaps
+
     async def telemetry(self, p):
         """Raw telemetry feed for `rt telemetry` / /api/telemetry:
         latest per-source metric snapshots + retained flight dumps.
         Aggregation happens client-side (util/telemetry.py)."""
         now = time.time()
         self._prune_metrics_sources(now)
+        sources = {s: v["snapshot"]
+                   for s, v in self.metrics_sources.items()}
+        # The controller reports itself inline — it has no agent to
+        # piggyback on, and its loop lag / RPC stats are exactly what
+        # the doctor's stall and convoy finders need to see.
+        sources["controller"] = self._self_metric_snaps()
         return {"ts": now,
-                "sources": {s: v["snapshot"]
-                            for s, v in self.metrics_sources.items()},
+                "sources": sources,
                 "flight": list(self.flight_dumps.values()),
                 "profiles": list(self.profile_artifacts)}
 
@@ -1577,6 +1617,7 @@ class Controller:
              "description": "Objects in the cluster directory.",
              "series": [{"tags": {}, "value": len(self.object_dir)}]},
         ]
+        internal.extend(self._self_metric_snaps())
         sources["controller"] = internal
         return {"text": render_prometheus(sources)}
 
@@ -1660,6 +1701,13 @@ class Controller:
             self._load_snapshot()
             spawn_task(self._persist_loop())
         await self.server.start(port)
+        # Event-loop lag sampler: the controller loop stalling is the
+        # single worst control-plane failure mode (every RPC convoys
+        # behind it), so it self-measures like workers/agents do.
+        from ray_tpu.util.hotpath import LoopLagSampler
+
+        self._loop_lag = LoopLagSampler(asyncio.get_event_loop())
+        self._loop_lag.start()
         spawn_task(self._health_loop())
         spawn_task(self._job_preemption_loop())
         if driver_pid:
@@ -1787,6 +1835,9 @@ class Controller:
 
     async def wait_shutdown(self) -> None:
         await self._shutdown.wait()
+        lag = getattr(self, "_loop_lag", None)
+        if lag is not None:
+            lag.stop()
         await self.server.stop()
 
 

@@ -402,6 +402,13 @@ class NodeAgent:
             "node_id": self.node_id, "agent_addr": self.server.address,
             "resources": dict(self.total.amounts), "labels": self.labels,
             "is_head": self.is_head})
+        # Event-loop lag ring: a starved agent loop (fork herds, big
+        # frame decodes) shows up as rt_loop_lag_seconds in telemetry
+        # and as an rt doctor event-loop-stall finding.
+        from ..util.hotpath import LoopLagSampler
+
+        self._loop_lag = LoopLagSampler(self._loop)
+        self._loop_lag.start()
         spawn_task(self._heartbeat_loop())
         spawn_task(self._reap_loop())
         if self.config.log_to_driver:
@@ -893,10 +900,15 @@ class NodeAgent:
         # The agent's own registry carries rt_worker_startup_seconds
         # (the only registry metric in this process) — ship it with
         # the node snapshot so `rt telemetry` sees the phase
-        # histogram without a separate reporting channel.
+        # histogram without a separate reporting channel.  Loop-lag
+        # quantiles and per-method RPC handler stats ride the same
+        # snapshot (control-plane introspection, util/hotpath.py).
         from ..util.metrics import registry
 
-        return list(registry().snapshot()) + [
+        lag = getattr(self, "_loop_lag", None)
+        extra = (lag.metric_snaps() if lag is not None else []) \
+            + self.server.stats.metric_snaps()
+        return list(registry().snapshot()) + extra + [
             {"name": "rt_worker_pool_idle", "kind": "gauge",
              "description": "Prestarted idle workers ready for "
                             "adoption (default runtime env).",

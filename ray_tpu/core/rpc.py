@@ -146,6 +146,12 @@ class RpcServer:
         self._conn_lost_cb: Optional[Callable[[str], None]] = None
         self._conns: Dict[str, asyncio.StreamWriter] = {}
         self._conn_counter = itertools.count()
+        # Per-method handler latency/inflight (loop-thread only; two
+        # attribute writes per dispatch).  Exported as rt_rpc_* by the
+        # owning process's metrics tick (util/hotpath.py).
+        from ..util.hotpath import RpcStats
+
+        self.stats = RpcStats()
 
     def register(self, name: str, fn: Callable) -> None:
         self._handlers[name] = fn
@@ -279,16 +285,20 @@ class RpcServer:
         if fn is None:
             logger.warning("no handler for notify %s", method)
             return
+        t0 = self.stats.enter(method)
         try:
             r = fn(payload)
             if asyncio.iscoroutine(r):
                 await r
         except Exception:
             logger.exception("notify handler %s failed", method)
+        finally:
+            self.stats.exit(method, t0)
 
     async def _dispatch(self, method: str, payload: Any, req_id: int,
                         send_frame, send_frame_bp=None) -> None:
         fn = self._handlers.get(method)
+        t0 = self.stats.enter(method)
         try:
             if fn is None:
                 raise LookupError(f"no RPC handler {method!r}")
@@ -305,6 +315,8 @@ class RpcServer:
             except Exception:
                 frame = _encode_frame(
                     (_ERROR, req_id, method, RuntimeError(repr(e))))
+        finally:
+            self.stats.exit(method, t0)
         try:
             if send_frame_bp is not None and len(frame) > (256 << 10):
                 await send_frame_bp(frame)

@@ -103,6 +103,10 @@ class TaskSpec:
     # Tracing: submitter's span context (ref: tracing_helper.py:88
     # span injection through submission); None when tracing is off.
     trace_ctx: Optional[Dict[str, str]] = None
+    # Hot-path introspection: preallocated perf_counter stamp slots
+    # (util/hotpath.py slot layout) on the sampled 1-in-N task; None
+    # for the unsampled fast path.
+    hp: Optional[List[float]] = None
 
     # num_returns sentinel for streaming generators (ref:
     # num_returns="streaming" / ObjectRefGenerator, _raylet.pyx:284):
@@ -157,3 +161,6 @@ class TaskResult:
     # blocked in get(), so queued work must fail over to another
     # worker instead of deadlocking behind it) — the owner re-enqueues.
     requeue: bool = False
+    # Hot-path introspection: the sampled spec's stamp vector echoed
+    # back with the worker-side slots filled (util/hotpath.py).
+    hp: Optional[List[float]] = None

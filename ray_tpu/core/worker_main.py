@@ -53,6 +53,7 @@ from .errors import ActorError, TaskCancelledError, TaskError  # noqa: E402
 from .ids import ActorID, JobID, WorkerID  # noqa: E402
 from .rpc import RpcClient, RpcError, RpcServer, spawn_task  # noqa: E402
 from .task import ArgKind, TaskResult, TaskSpec  # noqa: E402
+from ..util import hotpath  # noqa: E402  (stdlib-only; stamp slots)
 
 _IMPORT_DONE = _time_early.time()
 
@@ -149,6 +150,10 @@ class Worker:
             "worker_id": self.worker_id, "addr": self.server.address,
             "pid": os.getpid(), "phases": phases})
         self._agent = agent
+        # Event-loop health: scheduled-vs-actual lag ring, exported
+        # with the metrics tick (rt_loop_lag_seconds -> rt doctor).
+        self._loop_lag = hotpath.LoopLagSampler(self._loop)
+        self._loop_lag.start()
         spawn_task(self._watch_agent())
         spawn_task(self._flush_loop())
 
@@ -234,6 +239,13 @@ class Worker:
                     from ray_tpu.util.metrics import registry
 
                     snap = registry().snapshot()
+                    # Control-plane introspection rides the same tick:
+                    # loop-lag quantiles + per-method RPC handler
+                    # stats, synthesized in snapshot shape.
+                    lag = getattr(self, "_loop_lag", None)
+                    if lag is not None:
+                        snap = snap + lag.metric_snaps()
+                    snap = snap + self.server.stats.metric_snaps()
                     if snap:
                         await self._agent.call("report_metrics", {
                             "source": source,
@@ -515,6 +527,8 @@ class Worker:
             span = _tracing.child_context(spec.trace_ctx)
             _tracing.set_span_context(span)
         trace_extra = dict(span) if span else {}
+        if spec.hp is not None:
+            spec.hp[hotpath.EXEC_START] = time.perf_counter()
         self._emit_event(spec, "RUNNING", **trace_extra)
         try:
             pos, kwargs = self._resolve_args(spec)
@@ -529,6 +543,8 @@ class Worker:
             return TaskResult(task_id=spec.task_id, ok=False,
                               error=kind.from_exception(e))
         finally:
+            if spec.hp is not None:
+                spec.hp[hotpath.EXEC_END] = time.perf_counter()
             self._current_sync_task = None
             if spec.is_streaming:
                 # A streaming task that failed before its generator
@@ -605,6 +621,8 @@ class Worker:
             if fut is not None and fut.done():
                 continue
             self._task_running = True
+            if spec.hp is not None:
+                spec.hp[hotpath.WORKER_DISPATCH] = time.perf_counter()
             try:
                 fn = self._load_func(spec)
                 res = self._execute_sync(
@@ -615,6 +633,10 @@ class Worker:
                                  error=TaskError.from_exception(e))
             finally:
                 self._task_running = False
+            if spec.hp is not None:
+                # Echo the stamp vector on the reply so the owner can
+                # close the chain (REPLY_SENT lands at flush time).
+                res.hp = spec.hp
             if fut is not None:
                 loop.call_soon_threadsafe(
                     lambda f=fut, r=res:
@@ -657,6 +679,8 @@ class Worker:
             if spec.is_streaming:
                 self._stream_callers[spec.task_id.hex()] = \
                     p["caller_tag"]
+            if spec.hp is not None:
+                spec.hp[hotpath.WORKER_RECV] = time.perf_counter()
             self._task_queue.append((spec, ctx, None))
         self._ensure_task_runner()
 
@@ -680,6 +704,10 @@ class Worker:
     def _flush_results(self) -> None:
         buf, self._result_buf = self._result_buf, {}
         for tag, entries in buf.items():
+            for _rid, res in entries:
+                hp = getattr(res, "hp", None)
+                if hp is not None:
+                    hp[hotpath.REPLY_SENT] = time.perf_counter()
             self._send_peer(tag, "task_results", {"results": entries})
 
     # ---- peer-notify redelivery (the reply-loss fix): a notify that

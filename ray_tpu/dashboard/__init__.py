@@ -70,6 +70,8 @@ async function refresh() {
     `(goodput/MFU) · ` +
     `<a href="/api/doctor?format=text">/api/doctor</a> (health) · ` +
     `<a href="/api/perf?format=text">/api/perf</a> (roofline) · ` +
+    `<a href="/api/hotpath?format=text">/api/hotpath</a> ` +
+    `(control-plane phases) · ` +
     `<a href="/api/slo?format=text">/api/slo</a> (error budgets) · ` +
     `<a href="/api/trace">/api/trace</a> (slow requests) · ` +
     `<a href="/api/timeline">/api/timeline</a> (Perfetto trace)</p>`;
@@ -178,6 +180,20 @@ def create_app(address: Optional[str] = None):
                                 content_type="text/plain")
         return web.json_response(
             json.loads(json.dumps(rep, default=repr)))
+
+    async def hotpath(req):
+        """/api/hotpath — the control-plane hot-path phase
+        decomposition (`rt hotpath` JSON): per-phase p50/p99 and mean
+        shares of sampled task end-to-end latency.  ?format=text
+        renders the CLI report."""
+        from ..util import hotpath as hotpath_mod
+
+        snap = await call(state_api.hotpath)
+        if req.query.get("format") == "text":
+            return web.Response(text=hotpath_mod.render_text(snap),
+                                content_type="text/plain")
+        return web.json_response(
+            json.loads(json.dumps(snap, default=repr)))
 
     async def slo(req):
         """/api/slo — the SLO / error-budget report (`rt slo` JSON):
@@ -334,6 +350,7 @@ def create_app(address: Optional[str] = None):
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/api/doctor", doctor)
     app.router.add_get("/api/perf", perf)
+    app.router.add_get("/api/hotpath", hotpath)
     app.router.add_get("/api/telemetry", telemetry)
     app.router.add_get("/api/timeline", timeline)
     app.router.add_get("/api/slo", slo)

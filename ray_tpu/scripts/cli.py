@@ -539,6 +539,46 @@ def cmd_perf(args) -> int:
     return 0
 
 
+def cmd_hotpath(args) -> int:
+    """Control-plane hot-path decomposition: where the mean sampled
+    task's end-to-end latency goes, phase by phase (submit wakeup,
+    lease wait, send transit, worker queue, exec, reply flush/transit,
+    finalize), with per-phase p50/p99 across the cluster's sampled
+    records.  `--diff a.json b.json` compares two saved snapshots
+    offline (no cluster needed)."""
+    from ray_tpu.util import hotpath as hotpath_mod
+
+    if getattr(args, "diff", None):
+        path_a, path_b = args.diff
+        try:
+            with open(path_a) as f:
+                snap_a = json.load(f)
+            with open(path_b) as f:
+                snap_b = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cannot read snapshot: {e}", file=sys.stderr)
+            return 1
+        d = hotpath_mod.diff_snapshots(snap_a, snap_b)
+        if args.format == "json" or getattr(args, "json", False):
+            print(json.dumps(d, indent=2))
+        else:
+            sys.stdout.write(hotpath_mod.render_diff(d))
+        return 0
+
+    from ray_tpu.util import state as state_mod
+
+    address = resolve_address(address=args.address)
+    if not address:
+        print("No running cluster found.", file=sys.stderr)
+        return 1
+    snap = state_mod.hotpath(address=address)
+    if args.format == "json" or getattr(args, "json", False):
+        print(json.dumps(snap, indent=2, default=repr))
+    else:
+        sys.stdout.write(hotpath_mod.render_text(snap))
+    return 0
+
+
 def cmd_checkpoint_verify(args) -> int:
     """Offline integrity check of one checkpoint directory: commit
     status, manifest sanity, per-shard-file checksums, and slice
@@ -1075,6 +1115,22 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="shorthand for --format json (scripted "
                          "consumption in bench/CI)")
     sp.set_defaults(fn=cmd_perf)
+
+    sp = sub.add_parser("hotpath",
+                        help="control-plane hot-path phase "
+                             "decomposition (where sampled task "
+                             "latency goes: lease wait, transit, "
+                             "worker queue, exec, reply)")
+    sp.add_argument("--address", default="")
+    sp.add_argument("--format", choices=["text", "json"],
+                    default="text")
+    sp.add_argument("--json", action="store_true",
+                    help="shorthand for --format json (save a "
+                         "snapshot for later --diff)")
+    sp.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                    help="compare two saved --json snapshots: "
+                         "per-phase mean deltas A -> B")
+    sp.set_defaults(fn=cmd_hotpath)
 
     sp = sub.add_parser("doctor",
                         help="aggregated cluster health diagnosis "

@@ -1030,6 +1030,108 @@ def find_device_memory_pressure(metric_sources: Dict[str, List[Dict]],
     return out
 
 
+# ---------------------------------------- control-plane hot-path plane
+def find_event_loop_stalls(metric_sources: Dict[str, List[Dict]],
+                           warn_s: float = 0.25) -> List[Dict]:
+    """Flag processes whose asyncio event loop is stalling —
+    ``rt_loop_lag_seconds`` (util/hotpath.py LoopLagSampler) measures
+    how late a 250ms timer fires, i.e. how long SOMETHING held the
+    loop thread (unpickling a giant payload, sync I/O in a handler,
+    GC).  A lagging controller/agent/worker loop convoys every RPC
+    behind it; the sampler's ring is ~60s, so the finding clears once
+    the stall stops."""
+    out = []
+    for src, snaps in (metric_sources or {}).items():
+        for snap in snaps:
+            if snap.get("name") != "rt_loop_lag_seconds":
+                continue
+            by_q = {(s.get("tags") or {}).get("q"):
+                    float(s.get("value", 0.0))
+                    for s in snap.get("series", [])}
+            p99 = by_q.get("p99", 0.0)
+            if p99 <= warn_s:
+                continue
+            out.append(_finding(
+                "event_loop_stall", "warning",
+                f"event loop on {src} stalling: p99 lag "
+                f"{p99 * 1e3:.0f}ms (max "
+                f"{by_q.get('max', 0.0) * 1e3:.0f}ms)",
+                detail="The process's asyncio loop thread is being "
+                       "held — every RPC it serves and every timer "
+                       "it owns queues behind the stall.  Look for "
+                       "synchronous work on the loop (large pickles, "
+                       "blocking file I/O, long handler bodies).",
+                probe="rt hotpath   # which lifecycle phase absorbs it",
+                data={"source": src, "p99_s": p99,
+                      "max_s": by_q.get("max", 0.0),
+                      "p50_s": by_q.get("p50", 0.0)}))
+    return out
+
+
+def find_rpc_convoy(metrics_history: Dict[str, List],
+                    min_inflight: float = 4.0,
+                    min_samples: int = 4,
+                    latency_rise: float = 1.5) -> List[Dict]:
+    """Flag an RPC method convoying on one server: its inflight count
+    (``rt_rpc_inflight{method=...}``) held or grew across the recent
+    history window AND its mean handler latency (delta seconds_total /
+    delta calls_total) rose between the window's halves.  Queue depth
+    alone is load; queue depth with rising latency is a convoy — the
+    handler is slowing under its own backlog."""
+    out = []
+    for src, rows in (metrics_history or {}).items():
+        rows = [r for r in rows if len(r) == 2 and r[1]]
+        if len(rows) < min_samples:
+            continue
+        rows = rows[-max(min_samples, 8):]
+        flat_last = rows[-1][1]
+        methods = [k[len("rt_rpc_inflight{method="):-1]
+                   for k in flat_last
+                   if k.startswith("rt_rpc_inflight{method=")]
+        for m in methods:
+            ik = "rt_rpc_inflight{method=%s}" % m
+            infl = [float(f.get(ik, 0.0)) for _, f in rows]
+            if infl[-1] < min_inflight:
+                continue
+            if any(b < a for a, b in zip(infl, infl[1:])):
+                continue  # queue drained at some point — no convoy
+            sk = "rt_rpc_handler_seconds_total{method=%s}" % m
+            ck = "rt_rpc_handler_calls_total{method=%s}" % m
+
+            def _mean(a, b):
+                ds = float(rows[b][1].get(sk, 0.0)) - float(
+                    rows[a][1].get(sk, 0.0))
+                dc = float(rows[b][1].get(ck, 0.0)) - float(
+                    rows[a][1].get(ck, 0.0))
+                return (ds / dc) if dc > 0 else None
+
+            mid = len(rows) // 2
+            early = _mean(0, mid)
+            late = _mean(mid, len(rows) - 1)
+            if early is None or late is None or early <= 0:
+                continue
+            if late < early * latency_rise:
+                continue
+            out.append(_finding(
+                "rpc_convoy", "warning",
+                f"RPC {m} convoying on {src}: {infl[-1]:.0f} "
+                f"inflight, mean latency {early * 1e3:.1f}ms -> "
+                f"{late * 1e3:.1f}ms",
+                detail="The method's queue never drained across the "
+                       "window while its handler slowed "
+                       f"{late / early:.1f}x — callers are arriving "
+                       "faster than the handler completes and each "
+                       "arrival makes it worse.  Batch the callers, "
+                       "shed load, or move the handler's work off "
+                       "the loop.",
+                probe=f"rt hotpath   # phase cost; rt telemetry "
+                      f"# {src} load",
+                data={"source": src, "method": m,
+                      "inflight": infl[-1],
+                      "mean_early_s": early, "mean_late_s": late}))
+    return out
+
+
 # ----------------------------------------------------- orchestration
 def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
              load: Dict, pgs: List[Dict], nodes: List[Dict],
@@ -1050,7 +1152,9 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
              metric_sources: Optional[Dict[str, List[Dict]]] = None,
              recompile_churn_min: float = 8.0,
              device_memory_warn_frac: float = 0.90,
-             device_memory_critical_frac: float = 0.98
+             device_memory_critical_frac: float = 0.98,
+             metrics_history: Optional[Dict[str, List]] = None,
+             loop_lag_warn_s: float = 0.25
              ) -> Dict[str, Any]:
     """Pure aggregation of every check over already-fetched state
     (unit-testable without a cluster)."""
@@ -1091,6 +1195,9 @@ def diagnose(*, feed: Dict, tasks: List[Dict], spans: List[Dict],
     findings += find_device_memory_pressure(
         metric_sources or {}, warn_frac=device_memory_warn_frac,
         critical_frac=device_memory_critical_frac)
+    findings += find_event_loop_stalls(metric_sources or {},
+                                       warn_s=loop_lag_warn_s)
+    findings += find_rpc_convoy(metrics_history or {})
     findings.sort(key=lambda f: _SEV_ORDER.get(f["severity"], 9))
     return {
         "ts": now,
@@ -1209,6 +1316,10 @@ def cluster_diagnosis(*, address: Optional[str] = None,
                                                address=address)
         except Exception:
             serve_spans = []
+    try:
+        metrics_hist = state_api.metrics_history(address=address)
+    except Exception:
+        metrics_hist = {}
     return diagnose(
         feed=feed, tasks=tasks, spans=spans, load=load, pgs=pgs,
         nodes=nodes, ledgers=ledgers, serve=serve,
@@ -1237,7 +1348,13 @@ def cluster_diagnosis(*, address: Optional[str] = None,
             os.environ.get("RT_DEVICE_MEMORY_WARN_FRAC", "0.90")),
         device_memory_critical_frac=float(
             os.environ.get("RT_DEVICE_MEMORY_CRITICAL_FRAC",
-                           "0.98")))
+                           "0.98")),
+        # Hot-path plane inputs (event-loop stall / RPC-convoy
+        # finders): the per-source metric time series the controller
+        # retains for the dashboard.
+        metrics_history=metrics_hist,
+        loop_lag_warn_s=float(
+            os.environ.get("RT_LOOP_LAG_WARN_S", "0.25")))
 
 
 def render_text(diag: Dict[str, Any]) -> str:

@@ -1,0 +1,460 @@
+"""Control-plane hot-path introspection: phase-sliced task lifecycle
+timing, RPC handler stats, and event-loop lag sampling.
+
+The task path (owner submit -> pool lease -> worker exec -> batched
+reply -> owner result) is the load-bearing surface for every plane
+(data block tasks, serve fan-out, rollout dispatch), yet until now the
+only visibility was a single end-to-end ops/s scalar — perf PRs had to
+guess-and-A/B.  This module is the microscope:
+
+- **Phase stamps**: a sampled 1-in-N task (``RT_HOTPATH_SAMPLE``,
+  default 64; 0 disables) carries a preallocated 10-slot
+  ``perf_counter()`` vector in its existing TaskSpec/TaskResult
+  payload.  Each hop writes one bare float into its slot — no locks,
+  no RPCs, no loop wakeups on the hot path.  Completed vectors drain
+  on the owner's EXISTING 0.5 s task-event flush into the controller's
+  sink.
+- **Clock discipline**: ``perf_counter`` is CLOCK_MONOTONIC on Linux —
+  boot-relative and therefore comparable ACROSS PROCESSES on one host
+  (the CI topology).  Across hosts the offset is arbitrary: the two
+  transit phases (owner->worker, worker->owner) absorb the skew, are
+  clamped at zero, and any lost time lands in the explicit ``other``
+  residual rather than corrupting a named phase.
+- **RPC / loop instrumentation**: per-method handler latency +
+  inflight on every ``RpcServer`` (``rt_rpc_*``) and a per-process
+  scheduled-vs-actual loop-lag ring (``rt_loop_lag_seconds``), both
+  exported through each process's existing metrics tick.
+
+Everything here is stdlib-only: ``rt hotpath`` must render on an ops
+box with neither jax nor aiohttp (same contract as util/xprof.py).
+"""
+
+from __future__ import annotations
+
+import time
+from time import perf_counter
+from typing import Any, Dict, List, Optional
+
+# --------------------------------------------------------------- slots
+# One slot per lifecycle hop, in causal order.  The phase NAMED by
+# slot i is the interval (slot[i-1], slot[i]); a phase is only
+# credited when BOTH endpoints were stamped — a gap (non-pooled path,
+# lost stamp) falls into the explicit "other" residual instead of
+# silently inflating a neighbor.
+OWNER_SUBMIT = 0      # api.remote(): spec built on the user thread
+POOL_ENQUEUE = 1      # owner io loop: entered the sched-key queue
+OWNER_SEND = 2        # owner io loop: exec_batch notify about to ship
+WORKER_RECV = 3       # worker loop: exec_batch handler took the item
+WORKER_DISPATCH = 4   # worker executor thread: popped the task queue
+EXEC_START = 5        # worker: function loaded, about to run
+EXEC_END = 6          # worker: user function returned, result packaged
+REPLY_SENT = 7        # worker loop: task_results notify about to ship
+OWNER_REPLY_RECV = 8  # owner io loop: batched result arrived
+OWNER_DONE = 9        # owner io loop: returns stored, refs resolved
+
+N_SLOTS = 10
+
+# Phase names, keyed by the slot that ENDS the interval.
+PHASE_OF_SLOT: Dict[int, str] = {
+    POOL_ENQUEUE: "submit_wakeup",     # user thread -> io-loop pickup
+    OWNER_SEND: "lease_wait",          # queue wait until a lease takes it
+    WORKER_RECV: "send_transit",       # frame encode + wire + worker wakeup
+    WORKER_DISPATCH: "worker_queue",   # worker queue + executor handoff
+    EXEC_START: "func_load",           # code blob load / cache hit
+    EXEC_END: "exec",                  # arg resolve + user fn + packaging
+    REPLY_SENT: "reply_flush",         # result buffered until the flush
+    OWNER_REPLY_RECV: "reply_transit",  # wire back + owner loop wakeup
+    OWNER_DONE: "finalize",            # owner stores returns
+}
+
+PHASES: List[str] = [PHASE_OF_SLOT[i] for i in range(1, N_SLOTS)]
+
+
+# ------------------------------------------------------------ sampling
+def should_sample(task_id_hex: str, stride: int) -> bool:
+    """Deterministic 1-in-``stride`` decision from the task id alone —
+    the same task id always answers the same way in every process, so
+    the decision needs no coordination and unit tests can pin it.
+    ``stride <= 0`` disables sampling entirely."""
+    if stride <= 0:
+        return False
+    if stride == 1:
+        return True
+    return int(task_id_hex[:8], 16) % stride == 0
+
+
+def maybe_sample(spec, stride: int) -> None:
+    """Attach a fresh stamp vector to a sampled TaskSpec and stamp
+    OWNER_SUBMIT.  Called once per submission on the user thread; the
+    fast path for unsampled tasks is one modulo."""
+    try:
+        if should_sample(spec.task_id.hex(), stride):
+            hp = [0.0] * N_SLOTS
+            hp[OWNER_SUBMIT] = perf_counter()
+            spec.hp = hp
+    except Exception:
+        pass  # observability must never fail a submission
+
+
+def new_stamps() -> List[float]:
+    return [0.0] * N_SLOTS
+
+
+# --------------------------------------------------------- phase math
+def record_from_stamps(stamps: List[float],
+                       name: str = "") -> Optional[Dict[str, Any]]:
+    """One completed vector -> {name, e2e, phases, other}.
+
+    A phase is credited only when both its endpoint stamps are
+    present; the residual ``other`` = e2e - sum(named) is clamped at
+    zero (cross-host clock skew can push a clamped transit past the
+    true wall time).  Returns None when the vector cannot anchor an
+    end-to-end interval."""
+    if not stamps or len(stamps) < N_SLOTS:
+        return None
+    t0, tn = stamps[OWNER_SUBMIT], stamps[OWNER_DONE]
+    if t0 <= 0.0 or tn <= 0.0 or tn < t0:
+        return None
+    e2e = tn - t0
+    phases: Dict[str, float] = {}
+    named = 0.0
+    for i in range(1, N_SLOTS):
+        a, b = stamps[i - 1], stamps[i]
+        if a > 0.0 and b > 0.0:
+            d = b - a
+            if d < 0.0:
+                d = 0.0  # cross-host skew on a transit edge
+            phases[PHASE_OF_SLOT[i]] = d
+            named += d
+    return {"name": name, "e2e": e2e, "phases": phases,
+            "other": max(e2e - named, 0.0)}
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+class Sink:
+    """Controller-side aggregation of completed phase records.
+
+    Per phase: count, sum (for the additive mean decomposition) and a
+    bounded ring of recent values (for p50/p99).  The decomposition
+    divides every phase sum by the TOTAL record count, so phase means
+    plus ``other`` add up to the e2e mean exactly — `rt hotpath` can
+    show a step-by-step latency budget, not just per-phase
+    percentiles."""
+
+    def __init__(self, reservoir: int = 512):
+        self._reservoir = max(reservoir, 16)
+        self._phases: Dict[str, Dict[str, Any]] = {}
+        self._count = 0
+        self._e2e_sum = 0.0
+        self._other_sum = 0.0
+        self._e2e_ring: List[float] = []
+        self._e2e_idx = 0
+        self._sources: Dict[str, int] = {}
+        self._names: Dict[str, int] = {}
+
+    def _ring_add(self, cell: Dict[str, Any], v: float) -> None:
+        ring = cell["ring"]
+        if len(ring) < self._reservoir:
+            ring.append(v)
+        else:  # deterministic rolling window, oldest overwritten
+            ring[cell["idx"] % self._reservoir] = v
+            cell["idx"] += 1
+
+    def add(self, source: str, records: List[Dict[str, Any]]) -> None:
+        for rec in records or []:
+            try:
+                e2e = float(rec["e2e"])
+                phases = rec.get("phases") or {}
+            except (KeyError, TypeError, ValueError):
+                continue
+            self._count += 1
+            self._e2e_sum += e2e
+            self._other_sum += max(float(rec.get("other") or 0.0), 0.0)
+            if len(self._e2e_ring) < self._reservoir:
+                self._e2e_ring.append(e2e)
+            else:
+                self._e2e_ring[self._e2e_idx % self._reservoir] = e2e
+                self._e2e_idx += 1
+            for ph, v in phases.items():
+                cell = self._phases.get(ph)
+                if cell is None:
+                    cell = self._phases[ph] = {
+                        "count": 0, "sum": 0.0, "ring": [], "idx": 0}
+                cell["count"] += 1
+                cell["sum"] += float(v)
+                self._ring_add(cell, float(v))
+            if source:
+                self._sources[source] = self._sources.get(source, 0) + 1
+            nm = rec.get("name") or ""
+            if nm:
+                self._names[nm] = self._names.get(nm, 0) + 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        n = self._count
+        e2e_sorted = sorted(self._e2e_ring)
+        out_phases: List[Dict[str, Any]] = []
+        order = [p for p in PHASES if p in self._phases]
+        order += sorted(p for p in self._phases if p not in PHASES)
+        for ph in order:
+            cell = self._phases[ph]
+            vals = sorted(cell["ring"])
+            out_phases.append({
+                "phase": ph,
+                "count": cell["count"],
+                # Divide by TOTAL records: additive decomposition.
+                "mean_s": cell["sum"] / n if n else 0.0,
+                "p50_s": _quantile(vals, 0.50),
+                "p99_s": _quantile(vals, 0.99),
+                "share": (cell["sum"] / self._e2e_sum
+                          if self._e2e_sum > 0 else 0.0),
+            })
+        out_phases.append({
+            "phase": "other", "count": n,
+            "mean_s": self._other_sum / n if n else 0.0,
+            "p50_s": 0.0, "p99_s": 0.0,
+            "share": (self._other_sum / self._e2e_sum
+                      if self._e2e_sum > 0 else 0.0),
+        })
+        return {
+            "ts": time.time(),
+            "count": n,
+            "sample_note": "sampled 1-in-N tasks (RT_HOTPATH_SAMPLE)",
+            "e2e": {"mean_s": self._e2e_sum / n if n else 0.0,
+                    "p50_s": _quantile(e2e_sorted, 0.50),
+                    "p99_s": _quantile(e2e_sorted, 0.99)},
+            "phases": out_phases,
+            "sources": dict(self._sources),
+            "tasks": dict(sorted(self._names.items(),
+                                 key=lambda kv: -kv[1])[:16]),
+        }
+
+
+# ----------------------------------------------------------- rendering
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:7.3f}s "
+    return f"{v * 1e3:7.2f}ms"
+
+
+def render_text(snap: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    n = snap.get("count", 0)
+    lines.append("Control-plane hot path (sampled task lifecycle)")
+    lines.append(f"  records: {n}")
+    if not n:
+        lines.append("  no sampled records yet — submit tasks with "
+                     "RT_HOTPATH_SAMPLE >= 1 (default 64; 0 disables)")
+        return "\n".join(lines) + "\n"
+    e2e = snap.get("e2e") or {}
+    lines.append(f"  e2e     mean {_fmt_s(e2e.get('mean_s', 0.0))}  "
+                 f"p50 {_fmt_s(e2e.get('p50_s', 0.0))}  "
+                 f"p99 {_fmt_s(e2e.get('p99_s', 0.0))}")
+    lines.append("")
+    lines.append(f"  {'phase':<14} {'mean':>9} {'p50':>9} {'p99':>9} "
+                 f"{'share':>7} {'n':>7}")
+    for row in snap.get("phases") or []:
+        lines.append(
+            f"  {row['phase']:<14} {_fmt_s(row['mean_s']):>9} "
+            f"{_fmt_s(row['p50_s']):>9} {_fmt_s(row['p99_s']):>9} "
+            f"{row['share'] * 100:6.1f}% {row['count']:>7}")
+    srcs = snap.get("sources") or {}
+    if srcs:
+        lines.append("")
+        lines.append("  sources: " + ", ".join(
+            f"{s} ({c})" for s, c in sorted(srcs.items())))
+    tasks = snap.get("tasks") or {}
+    if tasks:
+        lines.append("  top tasks: " + ", ".join(
+            f"{t} ({c})" for t, c in list(tasks.items())[:8]))
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------- diffing
+def diff_snapshots(a: Dict[str, Any],
+                   b: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-phase deltas between two recorded snapshots (a = before,
+    b = after) — the artifact an optimization PR attaches to show
+    exactly which phase it bought."""
+    pa = {r["phase"]: r for r in a.get("phases") or []}
+    pb = {r["phase"]: r for r in b.get("phases") or []}
+    order = [p for p in PHASES + ["other"] if p in pa or p in pb]
+    order += [p for p in pb if p not in order]
+    order += [p for p in pa if p not in order]
+    rows = []
+    for ph in order:
+        ma = float((pa.get(ph) or {}).get("mean_s") or 0.0)
+        mb = float((pb.get(ph) or {}).get("mean_s") or 0.0)
+        sa = float((pa.get(ph) or {}).get("share") or 0.0)
+        sb = float((pb.get(ph) or {}).get("share") or 0.0)
+        rows.append({"phase": ph, "mean_a_s": ma, "mean_b_s": mb,
+                     "delta_s": mb - ma,
+                     "delta_pct": ((mb - ma) / ma * 100.0)
+                     if ma > 0 else 0.0,
+                     "share_a": sa, "share_b": sb})
+    ea = float((a.get("e2e") or {}).get("mean_s") or 0.0)
+    eb = float((b.get("e2e") or {}).get("mean_s") or 0.0)
+    return {"e2e": {"mean_a_s": ea, "mean_b_s": eb,
+                    "delta_s": eb - ea,
+                    "delta_pct": ((eb - ea) / ea * 100.0)
+                    if ea > 0 else 0.0},
+            "phases": rows,
+            "count_a": a.get("count", 0), "count_b": b.get("count", 0)}
+
+
+def render_diff(d: Dict[str, Any]) -> str:
+    lines = ["Hot-path diff (a -> b; negative delta = faster)"]
+    e = d.get("e2e") or {}
+    lines.append(
+        f"  e2e mean {_fmt_s(e.get('mean_a_s', 0.0))} -> "
+        f"{_fmt_s(e.get('mean_b_s', 0.0))}  "
+        f"({e.get('delta_s', 0.0) * 1e3:+.2f}ms, "
+        f"{e.get('delta_pct', 0.0):+.1f}%)")
+    lines.append(f"  records: {d.get('count_a', 0)} -> "
+                 f"{d.get('count_b', 0)}")
+    lines.append("")
+    lines.append(f"  {'phase':<14} {'a mean':>9} {'b mean':>9} "
+                 f"{'delta':>10} {'delta%':>8}")
+    for r in d.get("phases") or []:
+        lines.append(
+            f"  {r['phase']:<14} {_fmt_s(r['mean_a_s']):>9} "
+            f"{_fmt_s(r['mean_b_s']):>9} "
+            f"{r['delta_s'] * 1e3:+9.2f}ms {r['delta_pct']:+7.1f}%")
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------- RPC handler stats
+class _MethodStats:
+    __slots__ = ("count", "total_s", "max_s", "inflight")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self.inflight = 0
+
+
+class RpcStats:
+    """Per-method handler latency/inflight for one RpcServer.  All
+    mutation happens on the server's event loop (single thread), so
+    updates are two attribute writes — no locks on the dispatch hot
+    path."""
+
+    def __init__(self):
+        self.methods: Dict[str, _MethodStats] = {}
+
+    def enter(self, method: str) -> float:
+        st = self.methods.get(method)
+        if st is None:
+            st = self.methods[method] = _MethodStats()
+        st.inflight += 1
+        return perf_counter()
+
+    def exit(self, method: str, t0: float) -> None:
+        st = self.methods.get(method)
+        if st is None:
+            return
+        st.inflight -= 1
+        d = perf_counter() - t0
+        st.count += 1
+        st.total_s += d
+        if d > st.max_s:
+            st.max_s = d
+
+    def metric_snaps(self) -> List[Dict[str, Any]]:
+        """Synthesized registry-snapshot entries (same wire shape the
+        metrics plane ships) — riding the process's existing report
+        tick instead of allocating metric handles per method."""
+        if not self.methods:
+            return []
+        calls, secs, inflight, mx = [], [], [], []
+        for m, st in self.methods.items():
+            tags = {"method": m}
+            calls.append({"tags": tags, "value": float(st.count)})
+            secs.append({"tags": tags, "value": st.total_s})
+            inflight.append({"tags": tags, "value": float(st.inflight)})
+            mx.append({"tags": tags, "value": st.max_s})
+        return [
+            {"name": "rt_rpc_handler_calls_total", "kind": "counter",
+             "description": "RPC handler invocations by method.",
+             "series": calls},
+            {"name": "rt_rpc_handler_seconds_total", "kind": "counter",
+             "description": "Cumulative RPC handler seconds by method.",
+             "series": secs},
+            {"name": "rt_rpc_inflight", "kind": "gauge",
+             "description": "RPC handlers currently executing/queued "
+                            "by method.",
+             "series": inflight},
+            {"name": "rt_rpc_handler_max_seconds", "kind": "gauge",
+             "description": "Worst single handler latency by method.",
+             "series": mx},
+        ]
+
+
+# ------------------------------------------------ event-loop lag ring
+class LoopLagSampler:
+    """Scheduled-vs-actual callback delta ring: ``call_later(dt)``
+    firing late by L means the loop was busy/blocked for ~L.  One
+    self-rescheduling timer per process; the ring is a rolling window
+    so a past stall ages out (doctor findings CLEAR after the stall).
+    """
+
+    def __init__(self, loop, interval: float = 0.25, ring: int = 240):
+        self._loop = loop
+        self._interval = interval
+        self._ring: List[float] = []
+        self._size = max(ring, 8)
+        self._idx = 0
+        self._expected = 0.0
+        self._handle = None
+        self._stopped = False
+
+    def start(self) -> None:
+        self._expected = self._loop.time() + self._interval
+        self._handle = self._loop.call_later(self._interval, self._tick)
+
+    def _tick(self) -> None:
+        if self._stopped:
+            return
+        now = self._loop.time()
+        lag = max(now - self._expected, 0.0)
+        if len(self._ring) < self._size:
+            self._ring.append(lag)
+        else:
+            self._ring[self._idx % self._size] = lag
+            self._idx += 1
+        self._expected = now + self._interval
+        self._handle = self._loop.call_later(self._interval, self._tick)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._handle is not None:
+            self._handle.cancel()
+
+    def reset(self) -> None:
+        self._ring = []
+        self._idx = 0
+
+    def stats(self) -> Dict[str, float]:
+        vals = sorted(self._ring)
+        return {"p50": _quantile(vals, 0.50),
+                "p99": _quantile(vals, 0.99),
+                "max": vals[-1] if vals else 0.0,
+                "samples": float(len(vals))}
+
+    def metric_snaps(self) -> List[Dict[str, Any]]:
+        s = self.stats()
+        if not s["samples"]:
+            return []
+        return [{
+            "name": "rt_loop_lag_seconds", "kind": "gauge",
+            "description": "Event-loop lag (scheduled-vs-actual timer "
+                           "delta) over the rolling sample window.",
+            "series": [{"tags": {"q": q}, "value": s[q]}
+                       for q in ("p50", "p99", "max")],
+        }]

@@ -163,10 +163,15 @@ def main() -> None:
             results = []
             for name, rows in attempts.items():
                 rows.sort(key=lambda r: r["per_sec"])
-                median = statistics.median(
-                    [r["per_sec"] for r in rows])
+                rates = [r["per_sec"] for r in rows]
+                median = statistics.median(rates)
+                # Carry the attempt spread as noise bars: a ledger row
+                # whose min..max straddles its floor is a flaky
+                # signal, not a regression verdict.
                 results.append({**rows[len(rows) // 2],
                                 "per_sec": round(median, 1),
+                                "min": round(min(rates), 1),
+                                "max": round(max(rates), 1),
                                 "attempts": len(rows)})
         for row in results:
             print(json.dumps(row))
@@ -179,7 +184,10 @@ def main() -> None:
         source = "micro_quick" if args.quick else "micro"
         perf_ledger.record(
             [{"benchmark": r["benchmark"], "value": r["per_sec"],
-              "unit": "ops/s"} for r in results], source=source)
+              "unit": "ops/s",
+              **({"min": r["min"], "max": r["max"]}
+                 if "min" in r else {})}
+             for r in results], source=source)
 
 
 if __name__ == "__main__":

@@ -75,7 +75,8 @@ def record(entries: List[Dict[str, Any]], *, source: str,
            path: Optional[str] = None,
            round_tag: Optional[str] = None) -> None:
     """Append one line per metric: {ts, round, source, benchmark,
-    value, unit, higher_is_better}."""
+    value, unit, higher_is_better} (+ optional min/max noise bars
+    when the producer ran multiple attempts)."""
     path = path or DEFAULT_LEDGER
     ts = time.time()
     tag = round_tag or os.environ.get("RT_PERF_ROUND", "")
@@ -87,6 +88,9 @@ def record(entries: List[Dict[str, Any]], *, source: str,
                    "unit": e.get("unit", ""),
                    "higher_is_better":
                        bool(e.get("higher_is_better", True))}
+            for k in ("min", "max"):
+                if k in e:
+                    row[k] = float(e[k])
             f.write(json.dumps(row) + "\n")
 
 
@@ -122,6 +126,12 @@ def check_regressions(path: Optional[str] = None, *,
     for name, recs in by_metric.items():
         recs.sort(key=lambda r: r["ts"])
         latest = recs[-1]
+        if latest.get("unit") == "share":
+            # Decomposition rows (e.g. tasks_inflight_phase_*): a
+            # share legitimately moves when the workload mix or an
+            # optimization shifts where time goes — informational,
+            # never judged against best-ever.
+            continue
         floored = FLOORS.get(name)
         if floored is not None:
             floor, since_round = floored

@@ -129,6 +129,14 @@ def metrics_history(*, source: Optional[str] = None,
     return _call("metrics_history", {"source": source}, address)
 
 
+def hotpath(*, address: Optional[str] = None) -> Dict[str, Any]:
+    """Cluster-wide hot-path phase decomposition: sampled task
+    lifecycle stamps sliced into named phases (submit -> lease ->
+    transit -> exec -> reply) with per-phase p50/p99 and mean shares.
+    Rendered by `rt hotpath`; see ``ray_tpu.util.hotpath``."""
+    return _call("hotpath", {}, address)
+
+
 def telemetry(*, address: Optional[str] = None) -> Dict[str, Any]:
     """Raw training-telemetry feed: latest per-source metric snapshots
     + retained flight-recorder dumps.  Use

@@ -100,7 +100,8 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
     object_store = {"spilled_bytes": 0.0, "spill_total": 0.0,
                     "restore_total": 0.0}
     worker_pool = {"idle": 0.0, "target": 0.0, "adoptions": 0.0,
-                   "cold_spawns": 0.0, "startup": {}}
+                   "cold_spawns": 0.0, "events_dropped": 0.0,
+                   "startup": {}}
     llm = {"kv_pages_used": 0.0, "kv_pages_total": 0.0,
            "batch_size": 0.0, "waiting": 0.0, "tokens": 0.0,
            "prefill_tokens": 0.0, "evictions": 0.0, "engines": 0}
@@ -177,11 +178,14 @@ def cluster_summary(*, address: Optional[str] = None) -> Dict[str, Any]:
             continue
         if name in ("rt_worker_pool_idle", "rt_worker_pool_target",
                     "rt_worker_adoptions_total",
-                    "rt_worker_cold_spawn_total"):
+                    "rt_worker_cold_spawn_total",
+                    "rt_task_events_dropped_total"):
             key = {"rt_worker_pool_idle": "idle",
                    "rt_worker_pool_target": "target",
                    "rt_worker_adoptions_total": "adoptions",
-                   "rt_worker_cold_spawn_total": "cold_spawns"}[name]
+                   "rt_worker_cold_spawn_total": "cold_spawns",
+                   "rt_task_events_dropped_total":
+                       "events_dropped"}[name]
             for s in snap.get("series", []):
                 worker_pool[key] += float(s.get("value", 0.0))
             continue
@@ -585,13 +589,18 @@ def render_text(summary: Dict[str, Any]) -> str:
 
     pool = summary.get("worker_pool") or {}
     if pool.get("target") or pool.get("adoptions") \
-            or pool.get("cold_spawns"):
+            or pool.get("cold_spawns") or pool.get("events_dropped"):
         lines.append("\nWorker pool (control-plane fast path):")
         lines.append(f"  warm idle     {pool.get('idle', 0):.0f} / "
                      f"{pool.get('target', 0):.0f} target")
         lines.append(f"  adoptions     {pool.get('adoptions', 0):.0f}")
         lines.append(f"  cold spawns   "
                      f"{pool.get('cold_spawns', 0):.0f}")
+        if pool.get("events_dropped"):
+            # Nonzero means the observability plane is lossy under
+            # this load — `rt explain` chains may have gaps.
+            lines.append(f"  task events dropped  "
+                         f"{pool.get('events_dropped', 0):.0f}")
         for phase in ("spawn", "import", "connect", "adopt"):
             h = (pool.get("startup") or {}).get(phase)
             if h and h["count"]:

@@ -1,0 +1,496 @@
+"""Control-plane hot-path introspection plane (ISSUE 17).
+
+Fast half: the jax/aiohttp-free import guard for ``util/hotpath.py``
++ the ``rt hotpath`` CLI parser (an ops box must render the phase
+report and diff saved snapshots), pure units for the phase math
+(stamps -> phases -> additive decomposition, residual "other" never
+negative, deterministic sampling), the sink/diff/rendering layers,
+RPC handler stats, the event-loop lag sampler against a real stalled
+loop, and the doctor's stall/convoy finders (fire AND clear).  A
+2-node cluster acceptance test asserts a cross-process phase chain
+attributes >= 90% of mean e2e latency to named phases and that
+``--diff`` prints per-phase deltas.
+
+Slow half: an A/B overhead guard — batch-task throughput with the
+default sampling stride on must stay within 5% of sampling disabled.
+"""
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from ray_tpu.util import hotpath
+from ray_tpu.util.doctor import find_event_loop_stalls, find_rpc_convoy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -------------------------------------------------- import guard
+def test_hotpath_cli_import_without_jax_or_aiohttp():
+    """util/hotpath.py, the state wrapper, and the `rt hotpath`
+    parser must import AND compute on a box with neither jax nor
+    aiohttp — phase reports and snapshot diffs are ops-box tools."""
+    script = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+
+        class _Block:
+            BLOCKED = ("jax", "aiohttp", "flax", "optax")
+            def find_module(self, name, path=None):
+                root = name.split(".")[0]
+                return self if root in self.BLOCKED else None
+            def load_module(self, name):
+                raise ImportError(f"blocked import: {{name}}")
+
+        sys.meta_path.insert(0, _Block())
+        for mod in ("jax", "aiohttp"):
+            assert mod not in sys.modules
+
+        from ray_tpu.util import hotpath
+        from ray_tpu.util import state  # noqa: F401
+        from ray_tpu.scripts import cli
+
+        parser = cli._build_parser()
+        for args in (["hotpath"], ["hotpath", "--json"],
+                     ["hotpath", "--format", "json"],
+                     ["hotpath", "--diff", "a.json", "b.json"]):
+            ns = parser.parse_args(args)
+            assert callable(ns.fn)
+
+        # Pure compute path: stamps -> record -> sink -> text + diff.
+        st = hotpath.new_stamps()
+        for i in range(hotpath.N_SLOTS):
+            st[i] = 10.0 + i * 0.01
+        rec = hotpath.record_from_stamps(st, "nop")
+        assert rec is not None
+        sink = hotpath.Sink()
+        sink.add("owner-1", [rec])
+        snap = sink.snapshot()
+        text = hotpath.render_text(snap)
+        assert "lease_wait" in text and "exec" in text
+        d = hotpath.diff_snapshots(snap, snap)
+        assert "delta" in hotpath.render_diff(d)
+        print("GUARD_OK")
+    """)
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=120)
+    assert "GUARD_OK" in out.stdout, out.stderr + out.stdout
+
+
+# -------------------------------------------------- sampling
+def test_should_sample_deterministic_and_strided():
+    tid = "deadbeefcafe0123"
+    # The same id answers the same way every time, in every process.
+    assert all(hotpath.should_sample(tid, 64)
+               == hotpath.should_sample(tid, 64) for _ in range(10))
+    assert hotpath.should_sample(tid, 1) is True
+    assert hotpath.should_sample(tid, 0) is False
+    assert hotpath.should_sample(tid, -5) is False
+    # Stride N samples ~1/N of uniformly distributed ids (real task
+    # ids are random bytes; a Knuth-hash spreads the test counter).
+    hits = sum(hotpath.should_sample(
+        f"{(i * 2654435761) % 2 ** 32:08x}ffff", 16)
+        for i in range(4096))
+    assert 180 <= hits <= 340  # ~4096/16 = 256 expected
+
+
+def test_maybe_sample_attaches_vector_only_when_sampled():
+    class _Spec:
+        def __init__(self, tid):
+            self._tid = tid
+            self.hp = None
+
+        @property
+        def task_id(self):
+            class _Id:
+                def __init__(self, h):
+                    self._h = h
+
+                def hex(self):
+                    return self._h
+            return _Id(self._tid)
+
+    s = _Spec("0" * 16)  # int(...) % anything == 0 -> sampled
+    hotpath.maybe_sample(s, 64)
+    assert s.hp is not None and len(s.hp) == hotpath.N_SLOTS
+    assert s.hp[hotpath.OWNER_SUBMIT] > 0.0
+    s2 = _Spec("0" * 16)
+    hotpath.maybe_sample(s2, 0)  # disabled
+    assert s2.hp is None
+    s3 = _Spec("not-hex!")  # malformed id must never break submission
+    hotpath.maybe_sample(s3, 64)
+    assert s3.hp is None
+
+
+# -------------------------------------------------- phase math
+def _full_stamps(start=100.0, step=0.01):
+    st = hotpath.new_stamps()
+    for i in range(hotpath.N_SLOTS):
+        st[i] = start + i * step
+    return st
+
+
+def test_record_from_stamps_full_chain_sums_exactly():
+    rec = hotpath.record_from_stamps(_full_stamps(), "t")
+    assert rec["name"] == "t"
+    assert rec["e2e"] == pytest.approx(0.09)
+    assert set(rec["phases"]) == set(hotpath.PHASES)
+    assert sum(rec["phases"].values()) == pytest.approx(rec["e2e"])
+    assert rec["other"] == pytest.approx(0.0, abs=1e-12)
+
+
+def test_record_from_stamps_gap_falls_into_other():
+    st = _full_stamps()
+    # Lose the worker-side stamps (non-pooled path): the three phases
+    # touching them vanish; their time lands in "other", NOT in a
+    # neighboring named phase.
+    st[hotpath.WORKER_RECV] = 0.0
+    st[hotpath.WORKER_DISPATCH] = 0.0
+    rec = hotpath.record_from_stamps(st, "t")
+    for gone in ("send_transit", "worker_queue", "func_load"):
+        assert gone not in rec["phases"]
+    assert rec["other"] == pytest.approx(0.03)
+    assert rec["other"] >= 0.0
+    assert (sum(rec["phases"].values()) + rec["other"]
+            == pytest.approx(rec["e2e"]))
+
+
+def test_record_from_stamps_clock_skew_clamps_never_negative():
+    st = _full_stamps()
+    # Cross-host skew: the worker's clock is behind the owner's, so
+    # the send-transit edge goes backwards.  The phase clamps to zero
+    # and the residual stays non-negative.
+    st[hotpath.WORKER_RECV] = st[hotpath.OWNER_SEND] - 5.0
+    rec = hotpath.record_from_stamps(st, "t")
+    assert rec["phases"]["send_transit"] == 0.0
+    assert all(v >= 0.0 for v in rec["phases"].values())
+    assert rec["other"] >= 0.0
+
+
+def test_record_from_stamps_unanchored_returns_none():
+    st = hotpath.new_stamps()
+    assert hotpath.record_from_stamps(st) is None
+    st[hotpath.OWNER_SUBMIT] = 10.0  # no OWNER_DONE
+    assert hotpath.record_from_stamps(st) is None
+    st2 = _full_stamps()
+    st2[hotpath.OWNER_DONE] = st2[hotpath.OWNER_SUBMIT] - 1.0
+    assert hotpath.record_from_stamps(st2) is None
+    assert hotpath.record_from_stamps([1.0, 2.0]) is None
+
+
+# -------------------------------------------------- sink
+def test_sink_additive_decomposition_and_shares():
+    sink = hotpath.Sink()
+    recs = [hotpath.record_from_stamps(
+        _full_stamps(10.0 + i * 10.0, 0.01), "a") for i in range(50)]
+    # Half the records come from a gapped (non-pooled) path.
+    gapped = []
+    for i in range(50):
+        st = _full_stamps(1000.0 + i, 0.01)
+        st[hotpath.WORKER_RECV] = 0.0
+        gapped.append(hotpath.record_from_stamps(st, "b"))
+    sink.add("owner-1", recs)
+    sink.add("owner-2", gapped)
+    snap = sink.snapshot()
+    assert snap["count"] == 100
+    rows = {r["phase"]: r for r in snap["phases"]}
+    # Additive: phase means (incl. other) sum to the e2e mean exactly,
+    # even though some phases only appear on half the records.
+    assert (sum(r["mean_s"] for r in snap["phases"])
+            == pytest.approx(snap["e2e"]["mean_s"]))
+    # Shares sum to 1 and "other" carries exactly the gapped time.
+    assert (sum(r["share"] for r in snap["phases"])
+            == pytest.approx(1.0))
+    assert rows["other"]["share"] > 0.0
+    assert rows["send_transit"]["count"] == 50  # only ungapped records
+    assert snap["sources"] == {"owner-1": 50, "owner-2": 50}
+    assert snap["tasks"] == {"a": 50, "b": 50}
+    # Malformed records are skipped, not fatal.
+    sink.add("x", [{"bogus": 1}, None, {"e2e": "nan?"}])
+    assert sink.snapshot()["count"] == 100
+
+
+def test_sink_reservoir_rolls_oldest_out():
+    sink = hotpath.Sink(reservoir=16)
+    for i in range(100):
+        st = _full_stamps(float((i + 1) * 100), 0.001 * (i + 1))
+        sink.add("s", [hotpath.record_from_stamps(st, "t")])
+    snap = sink.snapshot()
+    assert snap["count"] == 100  # counters are totals...
+    # ...but quantiles only see the rolling window (the last 16
+    # records, whose e2e = 9 * step grows with i).
+    assert snap["e2e"]["p50_s"] >= 9 * 0.001 * 85
+
+
+def test_render_text_empty_sink_hints_at_sampling():
+    text = hotpath.render_text(hotpath.Sink().snapshot())
+    assert "RT_HOTPATH_SAMPLE" in text
+
+
+# -------------------------------------------------- diffing
+def test_diff_snapshots_and_render():
+    a, b = hotpath.Sink(), hotpath.Sink()
+    a.add("s", [hotpath.record_from_stamps(_full_stamps(10.0, 0.01),
+                                           "t") for _ in range(4)])
+    b.add("s", [hotpath.record_from_stamps(_full_stamps(10.0, 0.005),
+                                           "t") for _ in range(8)])
+    d = hotpath.diff_snapshots(a.snapshot(), b.snapshot())
+    assert d["count_a"] == 4 and d["count_b"] == 8
+    assert d["e2e"]["delta_s"] == pytest.approx(-0.045)
+    assert d["e2e"]["delta_pct"] == pytest.approx(-50.0)
+    rows = {r["phase"]: r for r in d["phases"]}
+    assert rows["lease_wait"]["delta_s"] == pytest.approx(-0.005)
+    text = hotpath.render_diff(d)
+    assert "lease_wait" in text and "-50.0%" in text
+
+
+# -------------------------------------------------- rpc stats
+def test_rpc_stats_tracks_latency_and_inflight():
+    st = hotpath.RpcStats()
+    t0 = st.enter("task_events")
+    assert st.methods["task_events"].inflight == 1
+    st.exit("task_events", t0)
+    m = st.methods["task_events"]
+    assert m.inflight == 0 and m.count == 1
+    assert m.total_s >= 0.0 and m.max_s >= m.total_s / max(m.count, 1)
+    snaps = {s["name"]: s for s in st.metric_snaps()}
+    assert set(snaps) == {"rt_rpc_handler_calls_total",
+                          "rt_rpc_handler_seconds_total",
+                          "rt_rpc_inflight",
+                          "rt_rpc_handler_max_seconds"}
+    series = snaps["rt_rpc_handler_calls_total"]["series"]
+    assert series[0]["tags"] == {"method": "task_events"}
+    assert series[0]["value"] == 1.0
+    assert hotpath.RpcStats().metric_snaps() == []
+
+
+# -------------------------------------------------- loop lag
+def test_loop_lag_sampler_detects_injected_stall_and_resets():
+    import asyncio
+
+    async def _scenario():
+        loop = asyncio.get_event_loop()
+        lag = hotpath.LoopLagSampler(loop, interval=0.02)
+        lag.start()
+        await asyncio.sleep(0.1)  # healthy ticks
+        healthy = lag.stats()
+        time.sleep(0.25)  # block the loop thread — the stall
+        await asyncio.sleep(0.1)  # let the late tick land
+        stalled = lag.stats()
+        lag.reset()
+        await asyncio.sleep(0.1)
+        cleared = lag.stats()
+        lag.stop()
+        return healthy, stalled, cleared
+
+    healthy, stalled, cleared = asyncio.new_event_loop() \
+        .run_until_complete(_scenario())
+    assert healthy["samples"] >= 2 and healthy["max"] < 0.1
+    assert stalled["max"] >= 0.15  # the injected stall is visible
+    assert cleared["max"] < 0.1  # and clears once the ring resets
+    snaps = hotpath.LoopLagSampler(None, interval=0.02).metric_snaps()
+    assert snaps == []  # no samples -> no series
+
+
+# -------------------------------------------------- doctor finders
+def _lag_snap(p50, p99, mx):
+    return [{"name": "rt_loop_lag_seconds", "kind": "gauge",
+             "series": [{"tags": {"q": "p50"}, "value": p50},
+                        {"tags": {"q": "p99"}, "value": p99},
+                        {"tags": {"q": "max"}, "value": mx}]}]
+
+
+def test_find_event_loop_stalls_fires_and_clears():
+    stalled = find_event_loop_stalls(
+        {"worker-a": _lag_snap(0.001, 0.8, 1.2),
+         "worker-b": _lag_snap(0.001, 0.002, 0.01)}, warn_s=0.25)
+    assert len(stalled) == 1
+    f = stalled[0]
+    assert f["check"] == "event_loop_stall"
+    assert f["severity"] == "warning"
+    assert "worker-a" in f["summary"]  # names the process
+    assert f["data"]["p99_s"] == pytest.approx(0.8)
+    # After the stall ages out of the rolling ring the finding clears.
+    assert find_event_loop_stalls(
+        {"worker-a": _lag_snap(0.001, 0.002, 0.01)}, warn_s=0.25) == []
+    assert find_event_loop_stalls({}, warn_s=0.25) == []
+
+
+def _convoy_rows(inflight, means, calls_step=100.0):
+    """Build a metrics_history deque for one method from an inflight
+    series and per-interval mean latencies."""
+    rows, secs, calls = [], 0.0, 0.0
+    for i, infl in enumerate(inflight):
+        if i > 0:
+            calls += calls_step
+            secs += means[i - 1] * calls_step
+        rows.append([float(i), {
+            "rt_rpc_inflight{method=task_events}": float(infl),
+            "rt_rpc_handler_calls_total{method=task_events}": calls,
+            "rt_rpc_handler_seconds_total{method=task_events}": secs}])
+    return rows
+
+
+def test_find_rpc_convoy_fires_on_growth_with_rising_latency():
+    hist = {"node-1": _convoy_rows(
+        [2, 3, 4, 5, 6, 8, 10, 12],
+        [0.001, 0.001, 0.001, 0.002, 0.004, 0.006, 0.008])}
+    out = find_rpc_convoy(hist)
+    assert len(out) == 1
+    f = out[0]
+    assert f["check"] == "rpc_convoy"
+    assert f["data"]["method"] == "task_events"
+    assert f["data"]["mean_late_s"] > f["data"]["mean_early_s"]
+    assert "node-1" in f["summary"]
+
+
+def test_find_rpc_convoy_ignores_drained_queue_and_flat_latency():
+    # Queue drained mid-window: load, not a convoy.
+    assert find_rpc_convoy({"n": _convoy_rows(
+        [2, 8, 3, 5, 6, 8, 10, 12],
+        [0.001, 0.001, 0.001, 0.002, 0.004, 0.006, 0.008])}) == []
+    # Queue held but the handler is NOT slowing: just steady load.
+    assert find_rpc_convoy({"n": _convoy_rows(
+        [5, 6, 7, 8, 9, 10, 11, 12],
+        [0.002, 0.002, 0.002, 0.002, 0.002, 0.002, 0.002])}) == []
+    # Inflight below the floor.
+    assert find_rpc_convoy({"n": _convoy_rows(
+        [0, 0, 0, 1, 1, 1, 2, 2],
+        [0.001, 0.001, 0.001, 0.002, 0.004, 0.006, 0.008])}) == []
+    assert find_rpc_convoy({}) == []
+    assert find_rpc_convoy({"n": []}) == []
+
+
+# -------------------------------------------------- cluster acceptance
+@pytest.fixture(scope="module")
+def hotpath_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    import ray_tpu
+
+    os.environ["RT_HOTPATH_SAMPLE"] = "1"  # sample every task
+    try:
+        c = Cluster(head_node_args={"num_cpus": 2})
+        c.add_node(num_cpus=2)
+        ray_tpu.init(address=c.address)
+        c.wait_for_nodes()
+        yield c
+        ray_tpu.shutdown()
+        c.shutdown()
+    finally:
+        os.environ.pop("RT_HOTPATH_SAMPLE", None)
+
+
+def test_two_node_hotpath_attributes_latency_and_diffs(
+        hotpath_cluster, tmp_path, capsys):
+    import ray_tpu
+    from ray_tpu.scripts import cli
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    def _snapshot_after(n):
+        ray_tpu.get([nop.remote() for _ in range(n)], timeout=120)
+        time.sleep(1.2)  # owner's 0.5s event-flush tick carries them
+        return state.hotpath()
+
+    snap_a = _snapshot_after(120)
+    assert snap_a["count"] >= 100
+    rows = {r["phase"]: r for r in snap_a["phases"]}
+    # The chain crossed processes: owner-side, wire, and worker-side
+    # phases all carry records.
+    for ph in ("submit_wakeup", "lease_wait", "send_transit",
+               "worker_queue", "exec", "reply_flush", "reply_transit",
+               "finalize"):
+        assert rows[ph]["count"] > 0, ph
+        assert rows[ph]["mean_s"] >= 0.0
+    # >= 90% of mean e2e latency is attributed to NAMED phases.
+    assert rows["other"]["share"] <= 0.10
+    assert (sum(r["mean_s"] for r in snap_a["phases"])
+            == pytest.approx(snap_a["e2e"]["mean_s"], rel=1e-6))
+    assert snap_a["sources"]  # the owner tag is attributed
+    assert "nop" in snap_a["tasks"]
+
+    # The controller reports itself as a telemetry source, carrying
+    # the satellite drop counter and its own loop/RPC instrumentation.
+    tel = state.telemetry()
+    ctl = {s["name"] for s in tel["sources"].get("controller", [])}
+    assert "rt_task_events_dropped_total" in ctl
+    assert "rt_rpc_handler_calls_total" in ctl
+    assert "rt_loop_lag_seconds" in ctl
+    # Workers/agents export their rpc + loop-lag planes too.
+    other_names = {s["name"]
+                   for src, snaps in tel["sources"].items()
+                   if src != "controller" for s in snaps}
+    assert "rt_loop_lag_seconds" in other_names
+    assert "rt_rpc_handler_calls_total" in other_names
+
+    # `rt hotpath` text rendering names the phases.
+    text = hotpath.render_text(snap_a)
+    assert "lease_wait" in text and "exec" in text
+
+    # Save two snapshots, diff them through the real CLI path.
+    snap_b = _snapshot_after(120)
+    pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+    pa.write_text(json.dumps(snap_a))
+    pb.write_text(json.dumps(snap_b))
+    parser = cli._build_parser()
+    ns = parser.parse_args(["hotpath", "--diff", str(pa), str(pb)])
+    assert ns.fn(ns) == 0
+    out = capsys.readouterr().out
+    assert "e2e mean" in out
+    for ph in ("lease_wait", "exec", "other"):
+        assert ph in out  # per-phase delta rows
+    ns = parser.parse_args(
+        ["hotpath", "--diff", str(pa), str(pb), "--json"])
+    assert ns.fn(ns) == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d["count_a"] >= 100 and d["count_b"] > d["count_a"]
+    assert {r["phase"] for r in d["phases"]} >= {"lease_wait", "exec"}
+
+
+# -------------------------------------------------- overhead guard
+@pytest.mark.slow
+def test_sampling_overhead_within_five_percent():
+    """A/B the batch-task throughput with the default stride on vs
+    sampling disabled: the stamp plumbing must cost < 5% median
+    throughput (the hot path's contract is 'one modulo when off,
+    ~10 bare floats when sampled')."""
+    import ray_tpu
+
+    def _median_rate(stride):
+        os.environ["RT_HOTPATH_SAMPLE"] = str(stride)
+        try:
+            ray_tpu.init(mode="cluster", num_cpus=2)
+
+            @ray_tpu.remote
+            def nop():
+                return None
+
+            ray_tpu.get([nop.remote() for _ in range(200)],
+                        timeout=120)  # warm
+            rates = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                ray_tpu.get([nop.remote() for _ in range(300)],
+                            timeout=120)
+                rates.append(300 / (time.perf_counter() - t0))
+            return statistics.median(rates)
+        finally:
+            ray_tpu.shutdown()
+            os.environ.pop("RT_HOTPATH_SAMPLE", None)
+
+    rate_off = _median_rate(0)
+    rate_on = _median_rate(64)
+    assert rate_on >= rate_off * 0.95, (
+        f"sampling overhead too high: on={rate_on:.0f} "
+        f"off={rate_off:.0f} ops/s "
+        f"({100 * (1 - rate_on / rate_off):.1f}% cost)")

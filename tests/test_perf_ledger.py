@@ -58,6 +58,34 @@ def test_regression_detected_lower_is_better(tmp_path):
     assert len(perf_ledger.check_regressions(path)) == 1
 
 
+def test_share_rows_are_informational_never_judged(tmp_path):
+    """Decomposition rows (unit="share", e.g. tasks_inflight_phase_*)
+    legitimately move when the workload mix shifts — a share halving
+    is not a regression."""
+    path = str(tmp_path / "PERF.jsonl")
+    _write(path, [
+        {"ts": 1, "source": "scale",
+         "benchmark": "tasks_inflight_phase_exec", "value": 0.40,
+         "unit": "share", "higher_is_better": True},
+        {"ts": 2, "source": "scale",
+         "benchmark": "tasks_inflight_phase_exec", "value": 0.05,
+         "unit": "share", "higher_is_better": True},
+    ])
+    assert perf_ledger.check_regressions(path) == []
+
+
+def test_record_passes_through_noise_bars(tmp_path):
+    path = str(tmp_path / "PERF.jsonl")
+    perf_ledger.record(
+        [{"benchmark": "a", "value": 100.0, "unit": "ops/s",
+          "min": 90.0, "max": 120.0},
+         {"benchmark": "b", "value": 5.0, "unit": "ops/s"}],
+        source="test", path=path)
+    rows = perf_ledger.load(path)
+    assert rows[0]["min"] == 90.0 and rows[0]["max"] == 120.0
+    assert "min" not in rows[1] and "max" not in rows[1]
+
+
 def test_single_record_is_baseline_not_regression(tmp_path):
     path = str(tmp_path / "PERF.jsonl")
     _write(path, [{"ts": 1, "source": "m", "benchmark": "x",
